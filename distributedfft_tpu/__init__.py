@@ -1,0 +1,43 @@
+"""distributedfft_tpu — TPU-native distributed 3D FFT framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+CUDA/MPI library eggersn/DistributedFFT: slab and pencil domain
+decompositions of 3D R2C/C2R (and C2C) FFTs, executed as single jitted XLA
+programs of local FFTs and mesh collectives over ICI/DCN, with the
+reference's plan/execute API shape, testcase semantics, benchmark timer and
+evaluation tooling.
+"""
+
+from .params import (
+    CommMethod,
+    Config,
+    FFTNorm,
+    GlobalSize,
+    PartitionDims,
+    PencilPartition,
+    SendMethod,
+    SlabPartition,
+    SlabSequence,
+    block_sizes,
+    block_starts,
+    padded_extent,
+)
+from .parallel.mesh import (
+    PENCIL_AXES,
+    SLAB_AXIS,
+    best_pencil_grid,
+    make_pencil_mesh,
+    make_slab_mesh,
+)
+from .models.base import DistFFTPlan
+from .models.slab import SlabFFTPlan
+
+__all__ = [
+    "CommMethod", "Config", "FFTNorm", "GlobalSize", "PartitionDims",
+    "PencilPartition", "SendMethod", "SlabPartition", "SlabSequence",
+    "block_sizes", "block_starts", "padded_extent",
+    "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
+    "make_slab_mesh", "DistFFTPlan", "SlabFFTPlan",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,132 @@
+"""Abstract distributed-FFT plan — the TPU analog of the reference's
+``MPIcuFFT<T>`` core runtime (``include/mpicufft.hpp:55-79``,
+``src/mpicufft.cpp``).
+
+API shape preserved from the reference: construct with a global size +
+partition ("initFFT"), query per-rank input/output extents
+(``getInSize/getInStart/getOutSize/getOutStart``), then execute forward /
+inverse transforms. What changes is the execution model: instead of a
+hand-scheduled pipeline of cuFFT calls, memcpy packs and MPI exchanges, a
+plan compiles ONE jitted XLA program (local FFT -> all_to_all -> local FFT
+[-> all_to_all -> local FFT]) over a ``jax.sharding.Mesh``, per BASELINE.json's
+north star.
+
+The reference's ``fft3d`` single-process fallback (``src/mpicufft.cpp:65``)
+maps to a mesh-less plan that calls ``jnp.fft.rfftn`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..ops import fft as local_fft
+from ..params import Config, FFTNorm, GlobalSize, Partition
+
+
+class DistFFTPlan:
+    """Base class for slab / pencil plans.
+
+    Subclasses populate ``_in_spec`` / ``_out_spec`` (PartitionSpecs over
+    ``self.mesh``) plus the per-rank size tables, and implement
+    ``_build_r2c`` / ``_build_c2r`` returning jitted callables over global
+    arrays. Construction is the analog of the reference's
+    ``initFFT(GlobalSize*, Partition*, allocate)``.
+    """
+
+    def __init__(self, global_size: GlobalSize, partition: Partition,
+                 config: Optional[Config] = None, mesh: Optional[Mesh] = None):
+        self.global_size = global_size
+        self.partition = partition
+        self.config = config or Config()
+        self.mesh = mesh
+        # Single-process fallback flag, exactly the reference's
+        # ``fft3d = (pcnt == 1)`` (src/mpicufft.cpp:65).
+        self.fft3d = mesh is None or partition.num_ranks == 1
+        self._r2c = None
+        self._c2r = None
+        self._in_spec: Optional[PartitionSpec] = None
+        self._out_spec: Optional[PartitionSpec] = None
+
+    # -- sharding queries (reference getInSize/getOutSize family) ---------
+
+    @property
+    def input_spec(self) -> PartitionSpec:
+        return PartitionSpec() if self.fft3d else self._in_spec
+
+    @property
+    def output_spec(self) -> PartitionSpec:
+        return PartitionSpec() if self.fft3d else self._out_spec
+
+    @property
+    def input_sharding(self) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, self.input_spec)
+
+    @property
+    def output_sharding(self) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, self.output_spec)
+
+    @property
+    def input_shape(self) -> Tuple[int, int, int]:
+        """Global real-space shape (x, y, z)."""
+        return self.global_size.shape
+
+    @property
+    def output_shape(self) -> Tuple[int, int, int]:
+        """Global spectral shape; subclasses override where the halved axis
+        is not z."""
+        g = self.global_size
+        return (g.nx, g.ny, g.nz_out)
+
+    def in_sizes(self, axis: str = "x") -> List[int]:
+        """Per-rank input extents along the decomposed axis(es)."""
+        raise NotImplementedError
+
+    def out_sizes(self, axis: str) -> List[int]:
+        raise NotImplementedError
+
+    # -- execution --------------------------------------------------------
+
+    def exec_r2c(self, x):
+        """Forward real-to-complex transform (reference ``execR2C``)."""
+        if self._r2c is None:
+            self._r2c = self._build_r2c()
+        return self._r2c(x)
+
+    def exec_c2r(self, x):
+        """Inverse complex-to-real transform (reference ``execC2R``)."""
+        if self._c2r is None:
+            self._c2r = self._build_c2r()
+        return self._c2r(x)
+
+    def _build_r2c(self):
+        raise NotImplementedError
+
+    def _build_c2r(self):
+        raise NotImplementedError
+
+    # -- single-device fallback ------------------------------------------
+
+    def _fft3d_r2c(self):
+        norm = self.config.norm
+
+        def run(x):
+            return local_fft.rfftn_3d(x, norm=norm)
+
+        return jax.jit(run)
+
+    def _fft3d_c2r(self):
+        norm = self.config.norm
+        shape = self.input_shape
+
+        def run(c):
+            return local_fft.irfftn_3d(c, shape, norm=norm)
+
+        return jax.jit(run)

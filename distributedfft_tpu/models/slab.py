@@ -1,0 +1,346 @@
+"""Slab (1D) decomposition engine — all three per-axis FFT sequences.
+
+TPU-native re-design of the reference's slab family:
+
+* ``ZY_Then_X`` (default): 2D FFT (y,z) -> transpose (x-split -> y-split) ->
+  1D FFT x (``src/slab/default/mpicufft_slab.cpp``).
+* ``Z_Then_YX``: 1D FFT z -> transpose (x-split -> z-split) -> 2D FFT (y,x);
+  output distributed over the halved z axis
+  (``src/slab/z_then_yx/mpicufft_slab_z_then_yx.cpp:96-104``).
+* ``Y_Then_ZX``: 1D R2C y -> transpose (x-split -> y-split) -> 2D FFT (z,x);
+  the halved axis is y (``src/slab/y_then_zx/mpicufft_slab_y_then_zx.cpp:95-103``).
+  The reference implements this sequence forward-only; here the inverse comes
+  for free from the shared pipeline builder and is provided as an extension.
+
+Where the reference implements seven classes x a 2x3 comm/send matrix of
+hand-scheduled pack/exchange/unpack variants, this engine expresses each
+sequence as ONE jitted XLA program parameterized by axis roles, with two
+communication strategies preserved for the reference's comparative spirit:
+
+* ``CommMethod.ALL2ALL``  -> explicit ``shard_map`` + ``lax.all_to_all``.
+* ``CommMethod.PEER2PEER`` -> GSPMD: global-view ops + sharding constraints;
+  XLA chooses/schedules the collectives (its latency-hiding scheduler is the
+  analog of the reference's Isend/Irecv + callback-thread overlap engine).
+
+``config.opt == 1`` maps to the "realigned" layout (sender-contiguous
+relayout before the collective), the analog of the reference's Opt1
+coordinate-transform classes (``include/mpicufft_slab_opt1.hpp:46-54``).
+
+Padded-shape contract
+---------------------
+XLA device meshes want extents divisible by the mesh axis, so every
+*decomposed* axis of a distributed global array is zero-padded up to the next
+multiple of P (``padded_extent``); undecomposed axes — including an odd
+``N/2+1`` halved axis that stays local — are never padded. Where the
+reference handles uneven extents with per-peer byte counts
+(``src/slab/default/mpicufft_slab.cpp:217-228``), this engine pads:
+
+* plan input  : real, ``input_padded_shape``  (x padded), sharded over x;
+* plan output : complex, ``output_padded_shape`` (split axis padded),
+  sharded over the split axis; pad lanes are exact zeros in forward output
+  and are ignored by the inverse.
+
+``pad_input`` / ``crop_real`` / ``pad_spectral`` / ``crop_spectral`` convert
+between logical and padded forms. For mesh-divisible sizes (every benchmark
+config) padded == logical and all of this is a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import params as pm
+from ..ops import fft as lf
+from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
+from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
+from .base import DistFFTPlan
+
+
+@dataclasses.dataclass(frozen=True)
+class _SeqDef:
+    """Axis roles for one slab sequence."""
+
+    r2c_axis: int                 # axis of the real-to-complex transform
+    pre_axes: Tuple[int, ...]     # C2C axes before the transpose
+    split_axis: int               # axis scattered by the transpose
+    post_axes: Tuple[int, ...]    # C2C axes after the transpose
+
+    @property
+    def halved(self) -> str:
+        """The logical axis carrying the n//2+1 halving (the R2C axis)."""
+        return "xyz"[self.r2c_axis]
+
+
+_SEQS = {
+    pm.SlabSequence.ZY_THEN_X: _SeqDef(2, (1,), 1, (0,)),
+    pm.SlabSequence.Z_THEN_YX: _SeqDef(2, (), 2, (1, 0)),
+    pm.SlabSequence.Y_THEN_ZX: _SeqDef(1, (), 1, (2, 0)),
+}
+
+
+class SlabFFTPlan(DistFFTPlan):
+    """Distributed 3D R2C/C2R FFT with 1D (slab) decomposition over x."""
+
+    def __init__(self, global_size: pm.GlobalSize, partition: pm.SlabPartition,
+                 config: Optional[pm.Config] = None, mesh: Optional[Mesh] = None,
+                 sequence: "pm.SlabSequence | str" = pm.SlabSequence.ZY_THEN_X):
+        if mesh is None and partition.p > 1:
+            mesh = make_slab_mesh(partition.p)
+        if mesh is not None and partition.p > 1:
+            if SLAB_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"slab mesh must have a {SLAB_AXIS!r} axis, got {mesh.axis_names}")
+            if mesh.shape[SLAB_AXIS] != partition.p:
+                raise ValueError(
+                    f"mesh axis {SLAB_AXIS!r} has {mesh.shape[SLAB_AXIS]} devices "
+                    f"but the partition asks for {partition.p}")
+        super().__init__(global_size, partition, config, mesh)
+        self.sequence = pm.SlabSequence.parse(sequence)
+        self._seq = _SEQS[self.sequence]
+        g, P = global_size, partition.p
+        self._P = P
+        if self._seq.halved == "z":
+            self._spec_shape = (g.nx, g.ny, g.nz_out)
+        else:
+            self._spec_shape = (g.nx, g.ny_out, g.nz)
+        self._split_ext = self._spec_shape[self._seq.split_axis]
+        if self.fft3d:
+            self._nx_pad = g.nx
+            self._split_pad = self._split_ext
+        else:
+            self._nx_pad = pm.padded_extent(g.nx, P)
+            self._split_pad = pm.padded_extent(self._split_ext, P)
+            self._in_spec = PartitionSpec(SLAB_AXIS, None, None)
+            out = [None, None, None]
+            out[self._seq.split_axis] = SLAB_AXIS
+            self._out_spec = PartitionSpec(*out)
+
+    # -- shapes & size tables (reference getInSize/getOutSize family,
+    #    include/mpicufft.hpp:66-79) --------------------------------------
+
+    @property
+    def output_shape(self) -> Tuple[int, int, int]:
+        return self._spec_shape
+
+    @property
+    def input_padded_shape(self) -> Tuple[int, int, int]:
+        g = self.global_size
+        return (self._nx_pad, g.ny, g.nz)
+
+    @property
+    def output_padded_shape(self) -> Tuple[int, int, int]:
+        s = list(self._spec_shape)
+        s[self._seq.split_axis] = self._split_pad
+        return tuple(s)
+
+    def in_sizes(self, axis: str = "x") -> List[int]:
+        if axis != "x":
+            raise ValueError("slab input is decomposed over x only")
+        return _shard_sizes(self.global_size.nx, self._nx_pad, self._P)
+
+    def out_sizes(self, axis: Optional[str] = None) -> List[int]:
+        """Per-rank extents of the decomposed output axis (y for ZY_Then_X /
+        Y_Then_ZX, z for Z_Then_YX) — logical extents, excluding pad lanes."""
+        expected = "xyz"[self._seq.split_axis]
+        if axis is not None and axis != expected:
+            raise ValueError(
+                f"{self.sequence.value} output is decomposed over {expected}")
+        return _shard_sizes(self._split_ext, self._split_pad, self._P)
+
+    # -- logical <-> padded conversion helpers ----------------------------
+
+    def pad_input(self, x):
+        """Logical real input -> padded, device-placed input shard layout.
+        Stays on device for jax arrays (no host round-trip)."""
+        pad = self._nx_pad - self.global_size.nx
+        if pad:
+            x = jnp.pad(x, [(0, pad), (0, 0), (0, 0)])
+        if self.mesh is not None:
+            x = jax.device_put(x, self.input_sharding)
+        return x
+
+    def crop_real(self, r):
+        """Padded inverse output -> logical (nx, ny, nz) host array."""
+        return np.asarray(r)[: self.global_size.nx]
+
+    def pad_spectral(self, c):
+        pad = self._split_pad - self._split_ext
+        if pad:
+            widths = [(0, 0)] * 3
+            widths[self._seq.split_axis] = (0, pad)
+            c = jnp.pad(c, widths)
+        if self.mesh is not None:
+            c = jax.device_put(c, self.output_sharding)
+        return c
+
+    def crop_spectral(self, c):
+        """Padded forward output -> logical spectral host array."""
+        c = np.asarray(c)
+        sl = [slice(None)] * 3
+        sl[self._seq.split_axis] = slice(0, self._split_ext)
+        return c[tuple(sl)]
+
+    # -- execution (auto-pad convenience) ---------------------------------
+
+    def exec_r2c(self, x):
+        if tuple(x.shape) not in (self.input_shape, self.input_padded_shape):
+            raise ValueError(
+                f"exec_r2c expects global shape {self.input_shape} (or padded "
+                f"{self.input_padded_shape}), got {tuple(x.shape)}")
+        if not self.fft3d and tuple(x.shape) == self.input_shape \
+                and self.input_shape != self.input_padded_shape:
+            x = self.pad_input(x)
+        return super().exec_r2c(x)
+
+    def exec_c2r(self, c):
+        if tuple(c.shape) not in (self.output_shape, self.output_padded_shape):
+            raise ValueError(
+                f"exec_c2r expects global shape {self.output_shape} (or padded "
+                f"{self.output_padded_shape}), got {tuple(c.shape)}")
+        if not self.fft3d and tuple(c.shape) == self.output_shape \
+                and self.output_shape != self.output_padded_shape:
+            c = self.pad_spectral(c)
+        return super().exec_c2r(c)
+
+    # -- pipeline builders -------------------------------------------------
+
+    def _build_r2c(self):
+        if self.fft3d:
+            return self._fft3d_r2c()
+        if self.config.comm_method is pm.CommMethod.PEER2PEER:
+            return self._build_r2c_gspmd()
+        return self._build_r2c_shard_map()
+
+    def _build_c2r(self):
+        if self.fft3d:
+            return self._fft3d_c2r()
+        if self.config.comm_method is pm.CommMethod.PEER2PEER:
+            return self._build_c2r_gspmd()
+        return self._build_c2r_shard_map()
+
+    # explicit collective path (CommMethod.ALL2ALL)
+
+    def _build_r2c_shard_map(self):
+        s, norm, g = self._seq, self.config.norm, self.global_size
+        realigned = self.config.opt == 1
+        split_pad, nx = self._split_pad, g.nx
+
+        def body(xl):
+            c = lf.rfft(xl, axis=s.r2c_axis, norm=norm)
+            for a in s.pre_axes:
+                c = lf.fft(c, axis=a, norm=norm)
+            c = pad_axis_to(c, s.split_axis, split_pad)
+            c = all_to_all_transpose(c, SLAB_AXIS, s.split_axis, 0,
+                                     realigned=realigned)
+            # Drop the zero pad rows of x before transforming along it.
+            c = slice_axis_to(c, 0, nx)
+            for a in s.post_axes:
+                c = lf.fft(c, axis=a, norm=norm)
+            return c
+
+        mesh = self.mesh
+        smapped = jax.shard_map(body, mesh=mesh, in_specs=self._in_spec,
+                                out_specs=self._out_spec)
+        return jax.jit(smapped,
+                       in_shardings=NamedSharding(mesh, self._in_spec),
+                       out_shardings=NamedSharding(mesh, self._out_spec))
+
+    def _build_c2r_shard_map(self):
+        s, norm, g = self._seq, self.config.norm, self.global_size
+        realigned = self.config.opt == 1
+        nx_pad, split_ext = self._nx_pad, self._split_ext
+        real_n = g.nz if s.halved == "z" else g.ny
+
+        def body(cl):
+            c = cl
+            for a in reversed(s.post_axes):
+                c = lf.ifft(c, axis=a, norm=norm)
+            c = pad_axis_to(c, 0, nx_pad)
+            c = all_to_all_transpose(c, SLAB_AXIS, 0, s.split_axis,
+                                     realigned=realigned)
+            # Drop the pad lanes of the split axis before inverting along the
+            # remaining axes.
+            c = slice_axis_to(c, s.split_axis, split_ext)
+            for a in reversed(s.pre_axes):
+                c = lf.ifft(c, axis=a, norm=norm)
+            return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm)
+
+        mesh = self.mesh
+        smapped = jax.shard_map(body, mesh=mesh, in_specs=self._out_spec,
+                                out_specs=self._in_spec)
+        return jax.jit(smapped,
+                       in_shardings=NamedSharding(mesh, self._out_spec),
+                       out_shardings=NamedSharding(mesh, self._in_spec))
+
+    # GSPMD path (CommMethod.PEER2PEER): local FFT stages are pinned via
+    # shard_map with matching in/out specs; the redistribution between the
+    # stages is NOT written explicitly — the stage boundary changes the
+    # sharding, and XLA's SPMD partitioner chooses and schedules the
+    # collective (it emits an all-to-all and overlaps it with neighbouring
+    # compute — the analog of the reference's hand-rolled Isend/Irecv +
+    # callback-thread overlap engine).
+
+    def _build_r2c_gspmd(self):
+        mesh, s, norm, g = self.mesh, self._seq, self.config.norm, self.global_size
+        in_ns = NamedSharding(mesh, self._in_spec)
+        out_ns = NamedSharding(mesh, self._out_spec)
+        split_pad, nx = self._split_pad, g.nx
+
+        def body1(xl):
+            c = lf.rfft(xl, axis=s.r2c_axis, norm=norm)
+            for a in s.pre_axes:
+                c = lf.fft(c, axis=a, norm=norm)
+            return pad_axis_to(c, s.split_axis, split_pad)
+
+        def body2(cl):
+            c = slice_axis_to(cl, 0, nx)
+            for a in s.post_axes:
+                c = lf.fft(c, axis=a, norm=norm)
+            return c
+
+        stage1 = jax.shard_map(body1, mesh=mesh, in_specs=self._in_spec,
+                               out_specs=self._in_spec)
+        stage2 = jax.shard_map(body2, mesh=mesh, in_specs=self._out_spec,
+                               out_specs=self._out_spec)
+        return jax.jit(lambda x: stage2(stage1(x)),
+                       in_shardings=in_ns, out_shardings=out_ns)
+
+    def _build_c2r_gspmd(self):
+        mesh, s, norm, g = self.mesh, self._seq, self.config.norm, self.global_size
+        in_ns = NamedSharding(mesh, self._in_spec)
+        out_ns = NamedSharding(mesh, self._out_spec)
+        real_n = g.nz if s.halved == "z" else g.ny
+        nx_pad, split_ext = self._nx_pad, self._split_ext
+
+        def body1(cl):
+            c = cl
+            for a in reversed(s.post_axes):
+                c = lf.ifft(c, axis=a, norm=norm)
+            return pad_axis_to(c, 0, nx_pad)
+
+        def body2(cl):
+            c = slice_axis_to(cl, s.split_axis, split_ext)
+            for a in reversed(s.pre_axes):
+                c = lf.ifft(c, axis=a, norm=norm)
+            return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm)
+
+        stage1 = jax.shard_map(body1, mesh=mesh, in_specs=self._out_spec,
+                               out_specs=self._out_spec)
+        stage2 = jax.shard_map(body2, mesh=mesh, in_specs=self._in_spec,
+                               out_specs=self._in_spec)
+        return jax.jit(lambda c: stage2(stage1(c)),
+                       in_shardings=out_ns, out_shardings=in_ns)
+
+
+def _shard_sizes(n: int, n_pad: int, p: int) -> List[int]:
+    """Logical per-rank extents under even padded sharding: each rank holds a
+    ``n_pad/p`` block; ranks past the logical extent hold only pad."""
+    b = n_pad // p
+    return [max(0, min(b, n - i * b)) for i in range(p)]

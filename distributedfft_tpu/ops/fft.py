@@ -1,0 +1,83 @@
+"""Local (per-shard) FFT layer — the TPU analog of the reference's L0 shim.
+
+The reference maps ``T in {float, double}`` to cuFFT types and exec function
+pointers (``include/cufft.hpp:23-61``). Here the same role is played by
+``jnp.fft`` lowered by XLA to its native FFT implementation; the dtype policy
+maps precision to (real, complex) jnp dtypes, and the normalization policy
+maps the cuFFT "unnormalized both ways" convention onto numpy norm strings.
+
+All functions are shape-polymorphic, jit-safe wrappers; batching comes from
+the untouched axes (cuFFT "batched plan" ≙ XLA treating other axes as batch).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..params import FFTNorm
+
+
+def dtypes_for(double_prec: bool) -> Tuple[jnp.dtype, jnp.dtype]:
+    """(real, complex) dtypes; f64/c128 requires ``jax_enable_x64`` and is
+    intended for CPU-backend correctness gates (TPU has no native f64)."""
+    if double_prec:
+        return jnp.dtype(jnp.float64), jnp.dtype(jnp.complex128)
+    return jnp.dtype(jnp.float32), jnp.dtype(jnp.complex64)
+
+
+def _fwd_norm(norm: FFTNorm) -> str:
+    # cuFFT forward is unnormalized == numpy "backward" forward.
+    return "ortho" if norm is FFTNorm.ORTHO else "backward"
+
+
+def _inv_norm(norm: FFTNorm) -> str:
+    # cuFFT inverse is also unnormalized; numpy's norm="forward" puts the
+    # full 1/N on the forward side, making the inverse unnormalized.
+    if norm is FFTNorm.NONE:
+        return "forward"
+    if norm is FFTNorm.ORTHO:
+        return "ortho"
+    return "backward"
+
+
+def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    """Forward R2C along one axis (cuFFT ``execR2C`` analog, 1D case)."""
+    return jnp.fft.rfft(x, axis=axis, norm=_fwd_norm(norm))
+
+
+def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    """Inverse C2R along one axis; ``n`` is the real output extent (needed
+    because the halved axis length ``n//2+1`` is ambiguous)."""
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_inv_norm(norm))
+
+
+def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    """Forward C2C along one axis (cuFFT ``execC2C(..., CUFFT_FORWARD)``)."""
+    return jnp.fft.fft(x, axis=axis, norm=_fwd_norm(norm))
+
+
+def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    """Inverse C2C along one axis (cuFFT ``execC2C(..., CUFFT_INVERSE)``)."""
+    return jnp.fft.ifft(x, axis=axis, norm=_inv_norm(norm))
+
+
+def fftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE):
+    return jnp.fft.fftn(x, axes=tuple(axes), norm=_fwd_norm(norm))
+
+
+def ifftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE):
+    return jnp.fft.ifftn(x, axes=tuple(axes), norm=_inv_norm(norm))
+
+
+def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE):
+    """Single-device full 3D R2C over the trailing three axes — the analog of
+    the reference's ``cufftMakePlan3d`` single-process fallback
+    (``src/mpicufft.cpp:65``, ``src/slab/default/mpicufft_slab.cpp:142-145``).
+    The halved axis is z (the last), matching cuFFT's layout."""
+    return jnp.fft.rfftn(x, axes=(-3, -2, -1), norm=_fwd_norm(norm))
+
+
+def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE):
+    return jnp.fft.irfftn(x, s=shape_3d, axes=(-3, -2, -1), norm=_inv_norm(norm))

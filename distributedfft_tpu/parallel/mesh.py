@@ -1,0 +1,57 @@
+"""Device-mesh construction — the TPU analog of the reference's MPI
+communicator bookkeeping.
+
+The reference derives rank layout from ``MPI_Comm_rank``/``MPI_Comm_split``
+(``src/mpicufft.cpp:46-51``; pencil sub-communicators
+``src/pencil/mpicufft_pencil.cpp:112-123``). Here the same roles are played by
+``jax.sharding.Mesh`` axes: a slab plan uses a 1D mesh ``('p',)``; a pencil
+plan a 2D mesh ``('p1', 'p2')`` where each axis is the analog of one
+sub-communicator (collectives over one named axis == communication within
+one MPI sub-communicator).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+SLAB_AXIS = "p"
+PENCIL_AXES = ("p1", "p2")
+
+
+def make_slab_mesh(p: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1D mesh over ``p`` devices (reference world == slab ranks)."""
+    if devices is None:
+        devices = jax.devices()
+    if p is None:
+        p = len(devices)
+    if p > len(devices):
+        raise ValueError(f"requested {p} slab ranks but only {len(devices)} devices")
+    return Mesh(np.asarray(devices[:p]).reshape(p), (SLAB_AXIS,))
+
+
+def make_pencil_mesh(p1: int, p2: int, devices: Optional[Sequence] = None) -> Mesh:
+    """2D mesh; axis ``p1`` is the column sub-communicator (second transpose),
+    ``p2`` the row sub-communicator (first transpose), matching the
+    reference's ``comm1``/``comm2`` split (``src/pencil/mpicufft_pencil.cpp:112-123``)."""
+    if devices is None:
+        devices = jax.devices()
+    need = p1 * p2
+    if need > len(devices):
+        raise ValueError(f"requested {p1}x{p2} pencil grid but only {len(devices)} devices")
+    return Mesh(np.asarray(devices[:need]).reshape(p1, p2), PENCIL_AXES)
+
+
+def best_pencil_grid(n: int) -> Tuple[int, int]:
+    """Most-square factorization of ``n`` into (p1, p2), the usual default
+    when a job spec gives only a rank count."""
+    best = (1, n)
+    for p1 in range(1, int(math.isqrt(n)) + 1):
+        if n % p1 == 0:
+            best = (p1, n // p1)
+    return best

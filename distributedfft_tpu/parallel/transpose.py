@@ -1,0 +1,71 @@
+"""Global transpose (redistribution) engine.
+
+This is the TPU-native replacement for the reference's entire L3 layer — the
+2x3 (comm x send) matrix of pack / MPI / unpack strategies duplicated in every
+decomposition class (``src/slab/default/mpicufft_slab.cpp:284-769``,
+``src/pencil/mpicufft_pencil.cpp:678-1482``). On TPU the redistribution is a
+single ``lax.all_to_all`` over a named mesh axis: XLA emits the device
+collective (riding ICI), fuses the pack/unpack relayouts into neighbouring
+ops, and its async scheduler overlaps compute with communication — the roles
+of the reference's ``cudaMemcpy2D/3DAsync`` packing, ``MPI_Isend/Alltoallv``
+and the Streams callback thread respectively.
+
+Uneven extents (notably the R2C halved axis ``Nz/2+1``,
+``params.hpp:30``) are handled by padding the split axis to a multiple of the
+mesh-axis size and slicing afterwards, where the reference uses per-peer byte
+counts (``src/slab/default/mpicufft_slab.cpp:217-228``). Padded lanes never
+mix with real data because every FFT runs along a different axis; they are
+sliced off at the plan boundary.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pad_axis_to(x, axis: int, target: int):
+    """Zero-pad ``axis`` up to ``target`` extent (no-op when already there)."""
+    cur = x.shape[axis]
+    if cur == target:
+        return x
+    if cur > target:
+        raise ValueError(f"axis {axis} extent {cur} exceeds pad target {target}")
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - cur)
+    return jnp.pad(x, widths)
+
+
+def slice_axis_to(x, axis: int, target: int):
+    """Take the leading ``target`` entries along ``axis`` (no-op when equal)."""
+    if x.shape[axis] == target:
+        return x
+    return lax.slice_in_dim(x, 0, target, axis=axis)
+
+
+def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
+                         *, realigned: bool = False):
+    """Redistribute inside ``shard_map``: scatter ``split_axis`` over the mesh
+    axis and gather ``concat_axis`` from it — one global transpose, the
+    analog of the reference's ``MPI_Alltoallv/w`` exchange.
+
+    ``realigned`` is the TPU rendering of the reference's "opt1" coordinate
+    transform (``include/mpicufft_slab_opt1.hpp:46-54``): the local block is
+    rotated so the split axis is leading *before* the collective (sender-side
+    contiguous, receiver repacks), instead of letting the collective pack the
+    strided slices on the sending side. Logical result is identical; the
+    physical relayout moves across the collective, which is exactly the axis
+    the reference's opt0/opt1 pair benchmarks.
+    """
+    if not realigned:
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    moved = jnp.moveaxis(x, split_axis, 0)
+    # concat position in the moved frame: axes > split shift left by one.
+    c = concat_axis if concat_axis < split_axis else concat_axis - 1
+    out = lax.all_to_all(moved, axis_name, split_axis=0, concat_axis=c + 1,
+                         tiled=True)
+    # After the exchange the former split axis sits at 0 with its local
+    # (post-split) extent; the concat axis has grown at position c+1. Move the
+    # residual split axis back to its logical slot.
+    return jnp.moveaxis(out, 0, split_axis)

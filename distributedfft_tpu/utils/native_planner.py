@@ -1,0 +1,66 @@
+"""Host-side partition arithmetic, optionally backed by the native C++ planner.
+
+The reference computes all partition tables (block extents with remainder
+spread, offsets, per-peer transfer counts) in C++ inside ``initFFT``
+(``src/slab/default/mpicufft_slab.cpp:112-128,183-229``). The TPU framework
+keeps that layer native as well: ``native/planner.cpp`` builds
+``libdfft_planner.so`` and this module binds it via ``ctypes`` with a pure
+Python fallback, so the package works before the native lib is built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import os
+from typing import List, Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    candidates = [
+        os.path.join(here, "native", "build", "libdfft_planner.so"),
+        os.path.join(here, "native", "libdfft_planner.so"),
+    ]
+    env = os.environ.get("DFFT_PLANNER_LIB")
+    if env:
+        candidates.insert(0, env)
+    for path in candidates:
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+                lib.dfft_block_sizes.argtypes = [
+                    ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+                lib.dfft_block_sizes.restype = ctypes.c_int
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+def block_sizes(n: int, p: int) -> List[int]:
+    """Block distribution of ``n`` over ``p`` with remainder spread over the
+    first parts (reference ``src/slab/default/mpicufft_slab.cpp:112-117``)."""
+    if p <= 0:
+        raise ValueError(f"partition count must be positive, got {p}")
+    if n < 0:
+        raise ValueError(f"extent must be non-negative, got {n}")
+    lib = _lib()
+    if lib is not None:
+        out = (ctypes.c_int64 * p)()
+        if lib.dfft_block_sizes(n, p, out) == 0:
+            return list(out)
+    base, rem = divmod(n, p)
+    return [base + 1 if i < rem else base for i in range(p)]
+
+
+def using_native() -> bool:
+    return _lib() is not None

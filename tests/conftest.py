@@ -1,0 +1,29 @@
+"""Test configuration: 8 virtual CPU devices + x64.
+
+The reference can only test multi-rank behavior on real clusters via SLURM
+(SURVEY §4: "no mock backend"); this framework tests its full multi-device
+sharding on a virtual CPU mesh, and f64 correctness gates run on the CPU
+backend (TPU has no native f64 — SURVEY §7 hard parts).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+# Must run before any backend initialization (conftest imports precede tests).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,86 @@
+"""Unit tests for the parameter/config model (reference L1b,
+``include/params.hpp``) — pure host-side, no devices needed."""
+
+import pytest
+
+from distributedfft_tpu import params as pm
+
+
+class TestGlobalSize:
+    def test_nz_out_halving(self):
+        # Nz_out = Nz/2 + 1 (reference params.hpp:30)
+        assert pm.GlobalSize(8, 8, 8).nz_out == 5
+        assert pm.GlobalSize(8, 8, 9).nz_out == 5
+        assert pm.GlobalSize(4, 4, 1024).nz_out == 513
+
+    def test_ny_out(self):
+        assert pm.GlobalSize(8, 10, 8).ny_out == 6
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            pm.GlobalSize(0, 4, 4)
+        with pytest.raises(ValueError):
+            pm.GlobalSize(4, -1, 4)
+
+    def test_totals(self):
+        g = pm.GlobalSize(2, 3, 4)
+        assert g.n_total == 24
+        assert g.shape == (2, 3, 4)
+
+
+class TestBlockDistribution:
+    def test_even(self):
+        assert pm.block_sizes(8, 4) == [2, 2, 2, 2]
+
+    def test_remainder_spread_over_first_ranks(self):
+        # Matches reference src/slab/default/mpicufft_slab.cpp:112-117.
+        assert pm.block_sizes(10, 4) == [3, 3, 2, 2]
+        assert pm.block_sizes(7, 4) == [2, 2, 2, 1]
+        assert pm.block_sizes(3, 4) == [1, 1, 1, 0]
+
+    def test_starts(self):
+        assert pm.block_starts([3, 3, 2, 2]) == [0, 3, 6, 8]
+
+    def test_padded_extent(self):
+        assert pm.padded_extent(17, 8) == 24
+        assert pm.padded_extent(16, 8) == 16
+        assert pm.padded_extent(1, 8) == 8
+
+
+class TestEnums:
+    def test_comm_parse(self):
+        assert pm.CommMethod.parse("Peer2Peer") is pm.CommMethod.PEER2PEER
+        assert pm.CommMethod.parse("all2all") is pm.CommMethod.ALL2ALL
+        assert pm.CommMethod.parse("a2a") is pm.CommMethod.ALL2ALL
+        with pytest.raises(ValueError):
+            pm.CommMethod.parse("bogus")
+
+    def test_send_parse(self):
+        assert pm.SendMethod.parse("Sync") is pm.SendMethod.SYNC
+        assert pm.SendMethod.parse("streams") is pm.SendMethod.STREAMS
+        assert pm.SendMethod.parse("MPI_Type") is pm.SendMethod.MPI_TYPE
+
+    def test_sequence_parse(self):
+        S = pm.SlabSequence
+        assert S.parse("default") is S.ZY_THEN_X
+        assert S.parse("Z_Then_YX") is S.Z_THEN_YX
+        assert S.parse("y_then_zx") is S.Y_THEN_ZX
+
+    def test_pencil_config_fallback(self):
+        cfg = pm.Config(comm_method=pm.CommMethod.PEER2PEER)
+        assert cfg.resolved_comm2() is pm.CommMethod.PEER2PEER
+        cfg2 = pm.Config(comm_method=pm.CommMethod.PEER2PEER,
+                         comm_method2=pm.CommMethod.ALL2ALL)
+        assert cfg2.resolved_comm2() is pm.CommMethod.ALL2ALL
+
+
+class TestPartitions:
+    def test_slab(self):
+        assert pm.SlabPartition(4).num_ranks == 4
+        with pytest.raises(ValueError):
+            pm.SlabPartition(0)
+
+    def test_pencil(self):
+        assert pm.PencilPartition(2, 4).num_ranks == 8
+        with pytest.raises(ValueError):
+            pm.PencilPartition(2, 0)

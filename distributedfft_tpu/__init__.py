@@ -30,6 +30,7 @@ from .parallel.mesh import (
     make_slab_mesh,
 )
 from .models.base import DistFFTPlan
+from .models.pencil import PencilFFTPlan
 from .models.slab import SlabFFTPlan
 
 __all__ = [
@@ -37,7 +38,7 @@ __all__ = [
     "PencilPartition", "SendMethod", "SlabPartition", "SlabSequence",
     "block_sizes", "block_starts", "padded_extent",
     "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
-    "make_slab_mesh", "DistFFTPlan", "SlabFFTPlan",
+    "make_slab_mesh", "DistFFTPlan", "PencilFFTPlan", "SlabFFTPlan",
 ]
 
 __version__ = "0.1.0"

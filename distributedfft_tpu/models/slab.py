@@ -143,7 +143,7 @@ class SlabFFTPlan(DistFFTPlan):
     def in_sizes(self, axis: str = "x") -> List[int]:
         if axis != "x":
             raise ValueError("slab input is decomposed over x only")
-        return _shard_sizes(self.global_size.nx, self._nx_pad, self._P)
+        return pm.even_shard_sizes(self.global_size.nx, self._nx_pad, self._P)
 
     def out_sizes(self, axis: Optional[str] = None) -> List[int]:
         """Per-rank extents of the decomposed output axis (y for ZY_Then_X /
@@ -152,7 +152,7 @@ class SlabFFTPlan(DistFFTPlan):
         if axis is not None and axis != expected:
             raise ValueError(
                 f"{self.sequence.value} output is decomposed over {expected}")
-        return _shard_sizes(self._split_ext, self._split_pad, self._P)
+        return pm.even_shard_sizes(self._split_ext, self._split_pad, self._P)
 
     # -- logical <-> padded conversion helpers ----------------------------
 
@@ -338,9 +338,3 @@ class SlabFFTPlan(DistFFTPlan):
         return jax.jit(lambda c: stage2(stage1(c)),
                        in_shardings=out_ns, out_shardings=in_ns)
 
-
-def _shard_sizes(n: int, n_pad: int, p: int) -> List[int]:
-    """Logical per-rank extents under even padded sharding: each rank holds a
-    ``n_pad/p`` block; ranks past the logical extent hold only pad."""
-    b = n_pad // p
-    return [max(0, min(b, n - i * b)) for i in range(p)]

@@ -138,6 +138,16 @@ def block_starts(sizes: Sequence[int]) -> List[int]:
     return starts
 
 
+def even_shard_sizes(n: int, n_pad: int, p: int) -> List[int]:
+    """Logical per-rank extents under even padded sharding: each rank holds a
+    ``n_pad/p`` block of the padded axis; ranks past the logical extent hold
+    only pad and report 0. This is what the framework's NamedShardings
+    actually materialize — distinct from the reference's remainder-spread
+    ``block_sizes``."""
+    b = n_pad // p
+    return [max(0, min(b, n - i * b)) for i in range(p)]
+
+
 def padded_extent(n: int, p: int) -> int:
     """Smallest multiple of ``p`` >= ``n``.
 

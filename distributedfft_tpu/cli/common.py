@@ -1,0 +1,93 @@
+"""Shared CLI plumbing for the three executables.
+
+The reference ships hand-rolled parsers (``getValueOfParam``/``checkFlag``,
+``tests/src/slab/main.cpp:76-118``) with both long and short option names;
+here argparse carries the same flag surface (argparse accepts multi-char
+short options like ``-nx`` verbatim).
+
+Device selection: by default the real backend is used (TPU under axon). Set
+``--emulate-devices N`` (or env ``DFFT_EMULATE_DEVICES``) to force N virtual
+CPU devices — the testing story the reference lacks (it can only test
+multi-rank on real clusters, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
+    ap.add_argument("--input-dim-x", "-nx", type=int, required=True,
+                    help="size of the input data in x-direction")
+    ap.add_argument("--input-dim-y", "-ny", type=int, required=True,
+                    help="size of the input data in y-direction")
+    ap.add_argument("--input-dim-z", "-nz", type=int, required=True,
+                    help="size of the input data in z-direction")
+    ap.add_argument("--testcase", "-t", type=int, default=0,
+                    help="which testcase to execute (0-4)")
+    ap.add_argument("--opt", "-o", type=int, default=0, choices=(0, 1),
+                    help="0: default layout; 1: realigned (coordinate "
+                         "transform) layout")
+    ap.add_argument("--iterations", "-i", type=int, default=1)
+    ap.add_argument("--warmup-rounds", "-w", type=int, default=0)
+    ap.add_argument("--cuda_aware", "-c", action="store_true",
+                    help="accepted for reference CLI compatibility; "
+                         "device-resident collectives are always on for TPU")
+    ap.add_argument("--double_prec", "-d", action="store_true",
+                    help="use float64/complex128 (CPU backend only; TPU has "
+                         "no native f64)")
+    ap.add_argument("--benchmark_dir", "-b", default="benchmarks",
+                    help="prefix for the benchmark directory")
+    ap.add_argument("--emulate-devices", type=int,
+                    default=int(os.environ.get("DFFT_EMULATE_DEVICES", "0")),
+                    help="force N virtual CPU devices (0 = use real backend)")
+    if pencil:
+        ap.add_argument("--comm-method1", "-comm1", default="Peer2Peer",
+                        help='"Peer2Peer" (XLA-scheduled redistribution) or '
+                             '"All2All" (explicit collective), transpose 1')
+        ap.add_argument("--send-method1", "-snd1", default="Sync",
+                        help="Sync | Streams | MPI_Type (layout hint, kept "
+                             "for reference CLI compatibility)")
+        ap.add_argument("--comm-method2", "-comm2", default=None,
+                        help="same as --comm-method1 for transpose 2")
+        ap.add_argument("--send-method2", "-snd2", default=None)
+    else:
+        ap.add_argument("--comm-method", "-comm", default="Peer2Peer")
+        ap.add_argument("--send-method", "-snd", default="Sync")
+
+
+def run_testcase(plan, args, dims=None) -> int:
+    """Dispatch -t N to the testcase implementations and print the perf
+    summary; shared by the slab and pencil executables. ``dims`` is the
+    pencil-only --fft-dim depth (None for slab)."""
+    import sys
+
+    from ..testing import testcases as tc
+
+    fn = {0: tc.testcase0, 1: tc.testcase1, 2: tc.testcase2,
+          3: tc.testcase3, 4: tc.testcase4}.get(args.testcase)
+    if fn is None:
+        print(f"unknown testcase {args.testcase}", file=sys.stderr)
+        return 2
+    kwargs = {}
+    if args.testcase in (0, 2, 3, 4):
+        kwargs.update(iterations=args.iterations, warmup=args.warmup_rounds)
+    if dims is not None and args.testcase != 4:
+        kwargs["dims"] = dims
+    result = fn(plan, **kwargs)
+    if "mean_ms" in result:
+        print(f"Run complete: {result['mean_ms']:.4f} ms "
+              f"(mean over {args.iterations} iterations)")
+    return 0
+
+
+def setup_backend(args) -> None:
+    """Apply device emulation before any jax backend use. Must be called
+    before the first jax device query."""
+    import jax
+    if args.emulate_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.emulate_devices)
+    if getattr(args, "double_prec", False):
+        jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,58 @@
+"""``pencil`` executable — reference CLI surface
+(``tests/src/pencil/main.cpp``) on the TPU framework.
+
+Example (reference: ``mpirun -n 4 pencil -nx 256 -ny 256 -nz 256 -p1 2 -p2 2
+-snd Streams -o 1 -i 10``):
+
+    python -m distributedfft_tpu.cli.pencil -nx 256 -ny 256 -nz 256 \
+        -p1 2 -p2 2 -o 1 -i 10 --emulate-devices 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .common import add_common_args, run_testcase, setup_backend
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="pencil", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    add_common_args(ap, pencil=True)
+    ap.add_argument("--partition1", "-p1", type=int, required=True,
+                    help="partitions in x-direction")
+    ap.add_argument("--partition2", "-p2", type=int, required=True,
+                    help="partitions in y-direction")
+    ap.add_argument("--fft-dim", "-f", type=int, default=3, choices=(1, 2, 3),
+                    help="number of transform dimensions (partial-dim exec)")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_backend(args)
+
+    from .. import params as pm
+    from ..testing import testcases as tc
+
+    g = pm.GlobalSize(args.input_dim_x, args.input_dim_y, args.input_dim_z)
+    cfg = pm.Config(
+        comm_method=pm.CommMethod.parse(args.comm_method1),
+        send_method=pm.SendMethod.parse(args.send_method1),
+        comm_method2=(pm.CommMethod.parse(args.comm_method2)
+                      if args.comm_method2 else None),
+        send_method2=(pm.SendMethod.parse(args.send_method2)
+                      if args.send_method2 else None),
+        opt=args.opt, cuda_aware=args.cuda_aware,
+        warmup_rounds=args.warmup_rounds, iterations=args.iterations,
+        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir)
+    plan = tc.make_plan("pencil", g,
+                        pm.PencilPartition(args.partition1, args.partition2),
+                        cfg)
+    return run_testcase(plan, args, dims=args.fft_dim)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""``reference`` executable — single-device baseline + communication
+microbenchmarks (reference ``tests/src/reference/main.cpp``,
+``tests/include/tests_reference.hpp:42-96``).
+
+Testcases:
+  0: full 3D FFT on one device (the reference's gather -> cufftMakePlan3d
+     baseline; in the single-controller model the gather is a device_put).
+  1: redistribution bandwidth, explicit All2All vs GSPMD (Peer2Peer) via
+     ``--opt 0|1``.
+  2: slab-geometry (1D mesh) transpose bandwidth.
+  3: pencil-geometry (2D mesh axis) transpose bandwidth.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .common import add_common_args, setup_backend
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="reference", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    add_common_args(ap, pencil=False)
+    ap.add_argument("--partition1", "-p1", type=int, default=0)
+    ap.add_argument("--partition2", "-p2", type=int, default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_backend(args)
+
+    import jax
+    import numpy as np
+    from ..testing import microbench as mb
+
+    shape = (args.input_dim_x, args.input_dim_y, args.input_dim_z)
+    dtype = np.float64 if args.double_prec else np.float32
+    it, wu = args.iterations, args.warmup_rounds
+
+    if args.testcase == 0:
+        ms = mb.single_device_fft_ms(shape, it, wu, dtype)
+        print(f"Run complete: {ms:.4f} ms (single-device 3D R2C, "
+              f"{shape[0]}x{shape[1]}x{shape[2]})")
+        return 0
+
+    p = len(jax.devices())
+    if args.testcase in (1, 2, 3):
+        explicit = args.opt != 0  # opt 0: Peer2Peer/GSPMD, opt 1: All2All
+        pencil_axis = args.testcase == 3
+        r = mb.transpose_bandwidth(shape, p, explicit=explicit,
+                                   iterations=it or 1, warmup=wu,
+                                   dtype=dtype, pencil_axis=pencil_axis)
+        kind = "All2All" if explicit else "Peer2Peer(GSPMD)"
+        geom = "pencil-axis" if pencil_axis else "slab"
+        print(f"Bandwidth: {r['gb_per_s'] * 1e3:.2f} MB/s "
+              f"[{kind}, {geom}, {p} devices, "
+              f"{r['bytes'] / 1e6:.1f} MB moved in {r['seconds'] * 1e3:.3f} ms]")
+        return 0
+    print(f"unknown testcase {args.testcase}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

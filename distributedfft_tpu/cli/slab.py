@@ -1,0 +1,56 @@
+"""``slab`` executable — reference CLI surface (``tests/src/slab/main.cpp``)
+on the TPU framework.
+
+Example (reference: ``mpirun -n 4 slab -nx 256 -ny 256 -nz 256 -s Z_Then_YX
+-snd Streams -o 1 -i 10``):
+
+    python -m distributedfft_tpu.cli.slab -nx 256 -ny 256 -nz 256 \
+        -s Z_Then_YX -o 1 -i 10 -p 4 --emulate-devices 4
+
+``-p`` replaces ``mpirun -n``: the decomposition width is a mesh-axis size,
+not a process count.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .common import add_common_args, run_testcase, setup_backend
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="slab", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    add_common_args(ap, pencil=False)
+    ap.add_argument("--sequence", "-s", default="ZY_Then_X",
+                    help='"ZY_Then_X" (default), "Z_Then_YX" or "Y_Then_ZX"')
+    ap.add_argument("--partitions", "-p", type=int, default=0,
+                    help="number of slabs (default: all devices)")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_backend(args)
+
+    import jax
+    from .. import params as pm
+    from ..testing import testcases as tc
+
+    p = args.partitions or len(jax.devices())
+    g = pm.GlobalSize(args.input_dim_x, args.input_dim_y, args.input_dim_z)
+    cfg = pm.Config(
+        comm_method=pm.CommMethod.parse(args.comm_method),
+        send_method=pm.SendMethod.parse(args.send_method),
+        opt=args.opt, cuda_aware=args.cuda_aware,
+        warmup_rounds=args.warmup_rounds, iterations=args.iterations,
+        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir)
+    plan = tc.make_plan("slab", g, pm.SlabPartition(p), cfg,
+                        sequence=args.sequence)
+    return run_testcase(plan, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,3 +130,17 @@ class DistFFTPlan:
             return local_fft.irfftn_3d(c, shape, norm=norm)
 
         return jax.jit(run)
+
+    # -- staged-execution helper (shared by slab/pencil) -------------------
+
+    def _jit_stages(self, specs):
+        """Jit each (desc, body, in_spec, out_spec) as its own shard_mapped
+        program so per-phase timers can fence between them."""
+        mesh = self.mesh
+        out = []
+        for desc, fn, ispec, ospec in specs:
+            sm = jax.shard_map(fn, mesh=mesh, in_specs=ispec, out_specs=ospec)
+            out.append((desc, jax.jit(
+                sm, in_shardings=NamedSharding(mesh, ispec),
+                out_shardings=NamedSharding(mesh, ospec))))
+        return out

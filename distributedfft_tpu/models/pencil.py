@@ -229,11 +229,12 @@ class PencilFFTPlan(DistFFTPlan):
             self._c2r_d[dims] = self._build_c2r_d(dims)
         return self._c2r_d[dims](c)
 
-    # -- pipeline builders -------------------------------------------------
+    # -- pipeline bodies ---------------------------------------------------
 
-    def _build_r2c_d(self, dims: int):
-        if self.fft3d:
-            return self._fft3d_r2c_d(dims)
+    def _fwd_parts(self, dims: int):
+        """(s1, t1, s2, t2, s3): local-FFT bodies and transpose bodies for
+        the forward pipeline at depth ``dims``; t's are None when the
+        pipeline stops before them."""
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
         nzc_p2, ny_p1 = self._nzc_p2, self._ny_p1
@@ -245,6 +246,9 @@ class PencilFFTPlan(DistFFTPlan):
                 c = pad_axis_to(c, 2, nzc_p2)
             return c
 
+        def t1(cl):
+            return all_to_all_transpose(cl, P2_AXIS, 2, 1, realigned=realigned)
+
         def s2(cl):
             c = slice_axis_to(cl, 1, ny)
             c = lf.fft(c, axis=1, norm=norm)
@@ -252,28 +256,18 @@ class PencilFFTPlan(DistFFTPlan):
                 c = pad_axis_to(c, 1, ny_p1)
             return c
 
+        def t2(cl):
+            return all_to_all_transpose(cl, P1_AXIS, 1, 0, realigned=realigned)
+
         def s3(cl):
             c = slice_axis_to(cl, 0, nx)
             return lf.fft(c, axis=0, norm=norm)
 
-        segments = [(s1, self._in_spec)]
-        if dims >= 2:
-            self._append(segments, self.config.comm_method,
-                         lambda c: all_to_all_transpose(
-                             c, P2_AXIS, 2, 1, realigned=realigned),
-                         self._mid_spec)
-            segments.append((s2, self._mid_spec))
-        if dims >= 3:
-            self._append(segments, self.config.resolved_comm2(),
-                         lambda c: all_to_all_transpose(
-                             c, P1_AXIS, 1, 0, realigned=realigned),
-                         self._out_spec)
-            segments.append((s3, self._out_spec))
-        return self._compile(segments, self._in_spec)
+        return (s1, t1 if dims >= 2 else None, s2,
+                t2 if dims >= 3 else None, s3)
 
-    def _build_c2r_d(self, dims: int):
-        if self.fft3d:
-            return self._fft3d_c2r_d(dims)
+    def _inv_parts(self, dims: int):
+        """(i3, t2b, i2, t1b, i1): inverse bodies mirroring ``_fwd_parts``."""
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
         nx_p1, ny_p2 = self._nx_p1, self._ny_p2
@@ -283,31 +277,116 @@ class PencilFFTPlan(DistFFTPlan):
             c = lf.ifft(cl, axis=0, norm=norm)
             return pad_axis_to(c, 0, nx_p1)
 
+        def t2b(cl):
+            return all_to_all_transpose(cl, P1_AXIS, 0, 1, realigned=realigned)
+
         def i2(cl):
             c = slice_axis_to(cl, 1, ny)
             c = lf.ifft(c, axis=1, norm=norm)
             return pad_axis_to(c, 1, ny_p2)
 
+        def t1b(cl):
+            return all_to_all_transpose(cl, P2_AXIS, 1, 2, realigned=realigned)
+
         def i1(cl):
             c = slice_axis_to(cl, 2, nzc)
             return lf.irfft(c, n=nz, axis=2, norm=norm)
 
+        return (i3 if dims >= 3 else None, t2b if dims >= 3 else None,
+                i2 if dims >= 2 else None, t1b if dims >= 2 else None, i1)
+
+    # -- pipeline builders -------------------------------------------------
+
+    def _build_r2c_d(self, dims: int):
+        if self.fft3d:
+            return self._fft3d_r2c_d(dims)
+        s1, t1, s2, t2, s3 = self._fwd_parts(dims)
+        segments = [(s1, self._in_spec)]
+        if dims >= 2:
+            self._append(segments, self.config.comm_method, t1, self._mid_spec)
+            segments.append((s2, self._mid_spec))
+        if dims >= 3:
+            self._append(segments, self.config.resolved_comm2(), t2,
+                         self._out_spec)
+            segments.append((s3, self._out_spec))
+        return self._compile(segments, self._in_spec)
+
+    def _build_c2r_d(self, dims: int):
+        if self.fft3d:
+            return self._fft3d_c2r_d(dims)
+        i3, t2b, i2, t1b, i1 = self._inv_parts(dims)
         segments: List = []
         if dims >= 3:
             segments.append((i3, self._out_spec))
-            self._append(segments, self.config.resolved_comm2(),
-                         lambda c: all_to_all_transpose(
-                             c, P1_AXIS, 0, 1, realigned=realigned),
+            self._append(segments, self.config.resolved_comm2(), t2b,
                          self._mid_spec)
         if dims >= 2:
             segments.append((i2, self._mid_spec))
-            self._append(segments, self.config.comm_method,
-                         lambda c: all_to_all_transpose(
-                             c, P2_AXIS, 1, 2, realigned=realigned),
-                         self._in_spec)
+            self._append(segments, self.config.comm_method, t1b, self._in_spec)
         segments.append((i1, self._in_spec))
         start = {3: self._out_spec, 2: self._mid_spec, 1: self._in_spec}[dims]
         return self._compile(segments, start)
+
+    # -- per-phase staged execution (benchmark timer support) --------------
+
+    variant_name = "pencil"
+
+    @property
+    def section_descriptions(self) -> List[str]:
+        """Reference pencil phase vocabulary
+        (include/mpicufft_pencil.hpp:263-287). Phases with no XLA analog
+        (pack/unpack/send bookkeeping) stay 0 in the CSV."""
+        def tr(prefix, send_complete):
+            xs = ["First Send", "Packing", "Start Local Transpose",
+                  "Start Receive", "First Receive", "Finished Receive",
+                  "Start All2All", "Finished All2All", "Unpacking"]
+            if send_complete:
+                xs.append("Send Complete")
+            return [f"{prefix} Transpose ({x})" for x in xs]
+        # 24 sections; only the First transpose has a "(Send Complete)"
+        # marker in the reference list.
+        return (["init", "1D FFT Z-Direction"] + tr("First", True)
+                + ["1D FFT Y-Direction"] + tr("Second", False)
+                + ["1D FFT X-Direction", "Run complete"])
+
+    def _xpose_desc(self, which: int) -> str:
+        comm = (self.config.comm_method if which == 1
+                else self.config.resolved_comm2())
+        prefix = "First" if which == 1 else "Second"
+        kind = ("Finished All2All" if comm is pm.CommMethod.ALL2ALL
+                else "Finished Receive")
+        return f"{prefix} Transpose ({kind})"
+
+    def forward_stages(self, dims: int = 3):
+        """[(phase desc, jitted stage fn)] for per-phase timed execution
+        (always explicit collectives; the fused exec path is unaffected)."""
+        if self.fft3d:
+            return [(None, lambda x: self.exec_r2c(x, dims))]
+        s1, t1, s2, t2, s3 = self._fwd_parts(dims)
+        specs = [("1D FFT Z-Direction", s1, self._in_spec, self._in_spec)]
+        if dims >= 2:
+            specs += [(self._xpose_desc(1), t1, self._in_spec, self._mid_spec),
+                      ("1D FFT Y-Direction", s2, self._mid_spec, self._mid_spec)]
+        if dims >= 3:
+            specs += [(self._xpose_desc(2), t2, self._mid_spec, self._out_spec),
+                      ("1D FFT X-Direction", s3, self._out_spec, self._out_spec)]
+        return self._jit_stages(specs)
+
+    def inverse_stages(self, dims: int = 3):
+        if self.fft3d:
+            return [(None, lambda c: self.exec_c2r(c, dims))]
+        i3, t2b, i2, t1b, i1 = self._inv_parts(dims)
+        specs = []
+        if dims >= 3:
+            specs += [("1D FFT X-Direction", i3, self._out_spec, self._out_spec),
+                      (self._xpose_desc(2), t2b, self._out_spec, self._mid_spec)]
+        if dims >= 2:
+            specs += [("1D FFT Y-Direction", i2, self._mid_spec, self._mid_spec),
+                      (self._xpose_desc(1), t1b, self._mid_spec, self._in_spec)]
+        specs.append(("1D FFT Z-Direction", i1, self._in_spec, self._in_spec))
+        return self._jit_stages(specs)
+
+
 
     @staticmethod
     def _append(segments, comm: pm.CommMethod, a2a, spec_after):

@@ -209,132 +209,175 @@ class SlabFFTPlan(DistFFTPlan):
             c = self.pad_spectral(c)
         return super().exec_c2r(c)
 
-    # -- pipeline builders -------------------------------------------------
+    # -- pipeline bodies ---------------------------------------------------
+    # Three reusable local bodies per direction. The fused builders compose
+    # them into one program; the GSPMD path drops the explicit transpose and
+    # lets the stage boundary trigger the collective; forward_stages()/
+    # inverse_stages() jit them individually for per-phase timing.
 
-    def _build_r2c(self):
-        if self.fft3d:
-            return self._fft3d_r2c()
-        if self.config.comm_method is pm.CommMethod.PEER2PEER:
-            return self._build_r2c_gspmd()
-        return self._build_r2c_shard_map()
-
-    def _build_c2r(self):
-        if self.fft3d:
-            return self._fft3d_c2r()
-        if self.config.comm_method is pm.CommMethod.PEER2PEER:
-            return self._build_c2r_gspmd()
-        return self._build_c2r_shard_map()
-
-    # explicit collective path (CommMethod.ALL2ALL)
-
-    def _build_r2c_shard_map(self):
+    def _fwd_parts(self):
         s, norm, g = self._seq, self.config.norm, self.global_size
         realigned = self.config.opt == 1
         split_pad, nx = self._split_pad, g.nx
 
-        def body(xl):
-            c = lf.rfft(xl, axis=s.r2c_axis, norm=norm)
-            for a in s.pre_axes:
-                c = lf.fft(c, axis=a, norm=norm)
-            c = pad_axis_to(c, s.split_axis, split_pad)
-            c = all_to_all_transpose(c, SLAB_AXIS, s.split_axis, 0,
-                                     realigned=realigned)
-            # Drop the zero pad rows of x before transforming along it.
-            c = slice_axis_to(c, 0, nx)
-            for a in s.post_axes:
-                c = lf.fft(c, axis=a, norm=norm)
-            return c
-
-        mesh = self.mesh
-        smapped = jax.shard_map(body, mesh=mesh, in_specs=self._in_spec,
-                                out_specs=self._out_spec)
-        return jax.jit(smapped,
-                       in_shardings=NamedSharding(mesh, self._in_spec),
-                       out_shardings=NamedSharding(mesh, self._out_spec))
-
-    def _build_c2r_shard_map(self):
-        s, norm, g = self._seq, self.config.norm, self.global_size
-        realigned = self.config.opt == 1
-        nx_pad, split_ext = self._nx_pad, self._split_ext
-        real_n = g.nz if s.halved == "z" else g.ny
-
-        def body(cl):
-            c = cl
-            for a in reversed(s.post_axes):
-                c = lf.ifft(c, axis=a, norm=norm)
-            c = pad_axis_to(c, 0, nx_pad)
-            c = all_to_all_transpose(c, SLAB_AXIS, 0, s.split_axis,
-                                     realigned=realigned)
-            # Drop the pad lanes of the split axis before inverting along the
-            # remaining axes.
-            c = slice_axis_to(c, s.split_axis, split_ext)
-            for a in reversed(s.pre_axes):
-                c = lf.ifft(c, axis=a, norm=norm)
-            return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm)
-
-        mesh = self.mesh
-        smapped = jax.shard_map(body, mesh=mesh, in_specs=self._out_spec,
-                                out_specs=self._in_spec)
-        return jax.jit(smapped,
-                       in_shardings=NamedSharding(mesh, self._out_spec),
-                       out_shardings=NamedSharding(mesh, self._in_spec))
-
-    # GSPMD path (CommMethod.PEER2PEER): local FFT stages are pinned via
-    # shard_map with matching in/out specs; the redistribution between the
-    # stages is NOT written explicitly — the stage boundary changes the
-    # sharding, and XLA's SPMD partitioner chooses and schedules the
-    # collective (it emits an all-to-all and overlaps it with neighbouring
-    # compute — the analog of the reference's hand-rolled Isend/Irecv +
-    # callback-thread overlap engine).
-
-    def _build_r2c_gspmd(self):
-        mesh, s, norm, g = self.mesh, self._seq, self.config.norm, self.global_size
-        in_ns = NamedSharding(mesh, self._in_spec)
-        out_ns = NamedSharding(mesh, self._out_spec)
-        split_pad, nx = self._split_pad, g.nx
-
-        def body1(xl):
+        def first(xl):
             c = lf.rfft(xl, axis=s.r2c_axis, norm=norm)
             for a in s.pre_axes:
                 c = lf.fft(c, axis=a, norm=norm)
             return pad_axis_to(c, s.split_axis, split_pad)
 
-        def body2(cl):
+        def xpose(cl):
+            return all_to_all_transpose(cl, SLAB_AXIS, s.split_axis, 0,
+                                        realigned=realigned)
+
+        def last(cl):
+            # Drop the zero pad rows of x before transforming along it.
             c = slice_axis_to(cl, 0, nx)
             for a in s.post_axes:
                 c = lf.fft(c, axis=a, norm=norm)
             return c
 
-        stage1 = jax.shard_map(body1, mesh=mesh, in_specs=self._in_spec,
-                               out_specs=self._in_spec)
-        stage2 = jax.shard_map(body2, mesh=mesh, in_specs=self._out_spec,
-                               out_specs=self._out_spec)
-        return jax.jit(lambda x: stage2(stage1(x)),
-                       in_shardings=in_ns, out_shardings=out_ns)
+        return first, xpose, last
 
-    def _build_c2r_gspmd(self):
-        mesh, s, norm, g = self.mesh, self._seq, self.config.norm, self.global_size
-        in_ns = NamedSharding(mesh, self._in_spec)
-        out_ns = NamedSharding(mesh, self._out_spec)
-        real_n = g.nz if s.halved == "z" else g.ny
+    def _inv_parts(self):
+        s, norm, g = self._seq, self.config.norm, self.global_size
+        realigned = self.config.opt == 1
         nx_pad, split_ext = self._nx_pad, self._split_ext
+        real_n = g.nz if s.halved == "z" else g.ny
 
-        def body1(cl):
+        def first(cl):
             c = cl
             for a in reversed(s.post_axes):
                 c = lf.ifft(c, axis=a, norm=norm)
             return pad_axis_to(c, 0, nx_pad)
 
-        def body2(cl):
+        def xpose(cl):
+            return all_to_all_transpose(cl, SLAB_AXIS, 0, s.split_axis,
+                                        realigned=realigned)
+
+        def last(cl):
+            # Drop the pad lanes of the split axis before inverting along the
+            # remaining axes.
             c = slice_axis_to(cl, s.split_axis, split_ext)
             for a in reversed(s.pre_axes):
                 c = lf.ifft(c, axis=a, norm=norm)
             return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm)
 
-        stage1 = jax.shard_map(body1, mesh=mesh, in_specs=self._out_spec,
-                               out_specs=self._out_spec)
-        stage2 = jax.shard_map(body2, mesh=mesh, in_specs=self._in_spec,
-                               out_specs=self._in_spec)
-        return jax.jit(lambda c: stage2(stage1(c)),
-                       in_shardings=out_ns, out_shardings=in_ns)
+        return first, xpose, last
+
+    # -- pipeline builders -------------------------------------------------
+
+    def _build_r2c(self):
+        if self.fft3d:
+            return self._fft3d_r2c()
+        return self._assemble(self._fwd_parts(), self._in_spec, self._out_spec,
+                              self.config.comm_method)
+
+    def _build_c2r(self):
+        if self.fft3d:
+            return self._fft3d_c2r()
+        return self._assemble(self._inv_parts(), self._out_spec, self._in_spec,
+                              self.config.comm_method)
+
+    def _assemble(self, parts, in_spec, out_spec, comm: pm.CommMethod):
+        """Compose (first, xpose, last) into one jitted program.
+
+        ALL2ALL: a single shard_map containing the explicit collective.
+        PEER2PEER: two shard_map stages with the transpose omitted — the
+        sharding change at the stage boundary makes XLA's SPMD partitioner
+        insert and schedule the collective (its latency-hiding scheduler is
+        the analog of the reference's Isend/Irecv + callback-thread overlap
+        engine)."""
+        first, xpose, last = parts
+        mesh = self.mesh
+        in_ns = NamedSharding(mesh, in_spec)
+        out_ns = NamedSharding(mesh, out_spec)
+        if comm is pm.CommMethod.ALL2ALL:
+            fused = jax.shard_map(lambda xl: last(xpose(first(xl))), mesh=mesh,
+                                  in_specs=in_spec, out_specs=out_spec)
+            return jax.jit(fused, in_shardings=in_ns, out_shardings=out_ns)
+        stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
+                               out_specs=in_spec)
+        stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
+                               out_specs=out_spec)
+        return jax.jit(lambda x: stage2(stage1(x)),
+                       in_shardings=in_ns, out_shardings=out_ns)
+
+    # -- per-phase staged execution (benchmark timer support) --------------
+
+    @property
+    def variant_name(self) -> str:
+        return {
+            pm.SlabSequence.ZY_THEN_X: "slab_default",
+            pm.SlabSequence.Z_THEN_YX: "slab_z_then_yx",
+            pm.SlabSequence.Y_THEN_ZX: "slab_y_then_zx",
+        }[self.sequence]
+
+    @property
+    def section_descriptions(self) -> List[str]:
+        """Reference phase vocabulary for this sequence (slab default:
+        include/mpicufft_slab.hpp:209-223; z_then_yx: :121-134; y_then_zx:
+        :107-109). Phases that have no analog under XLA (pack/unpack/send
+        bookkeeping) remain 0 in the CSV."""
+        first, last = self._stage_descs()
+        xpose = ["Transpose (First Send)", "Transpose (Packing)",
+                 "Transpose (Start Local Transpose)", "Transpose (Start Receive)",
+                 "Transpose (First Receive)", "Transpose (Finished Receive)",
+                 "Transpose (Start All2All)", "Transpose (Finished All2All)",
+                 "Transpose (Unpacking)"]
+        if self.sequence is pm.SlabSequence.ZY_THEN_X:
+            # The reference slab_default list carries an extra "2D FFT (Sync)"
+            # marker before the 2D FFT row (mpicufft_slab.hpp:209-223).
+            return ["init", "2D FFT (Sync)", first] + xpose + [last,
+                                                               "Run complete"]
+        if self.sequence is pm.SlabSequence.Y_THEN_ZX:
+            # y_then_zx has the short 9-entry list (mpicufft_slab_y_then_zx
+            # .hpp:107-109): only P2P phases, no All2All markers.
+            return ["init", first, "Transpose (First Send)",
+                    "Transpose (Packing)", "Transpose (Start Local Transpose)",
+                    "Transpose (Start Receive)", "Transpose (Finished Receive)",
+                    last, "Run complete"]
+        return ["init", first] + xpose + [last, "Run complete"]
+
+    def _stage_descs(self) -> Tuple[str, str]:
+        return {
+            pm.SlabSequence.ZY_THEN_X: ("2D FFT Y-Z-Direction", "1D FFT X-Direction"),
+            pm.SlabSequence.Z_THEN_YX: ("1D FFT Z-Direction", "2D FFT Y-X-Direction"),
+            pm.SlabSequence.Y_THEN_ZX: ("1D FFT Y-Direction", "2D FFT Z-X-Direction"),
+        }[self.sequence]
+
+    def _xpose_desc(self) -> str:
+        # y_then_zx's short reference list has no All2All markers (it is
+        # hardcoded Peer2Peer there); keep its transpose time under the
+        # receive marker for either comm method.
+        if self.sequence is pm.SlabSequence.Y_THEN_ZX:
+            return "Transpose (Finished Receive)"
+        return ("Transpose (Finished All2All)"
+                if self.config.comm_method is pm.CommMethod.ALL2ALL
+                else "Transpose (Finished Receive)")
+
+    def forward_stages(self):
+        """[(phase desc, jitted stage fn)] for per-phase timed execution.
+        Always uses the explicit collective (timing needs a materialization
+        boundary); the fused exec path is unaffected."""
+        if self.fft3d:
+            return [(None, self.exec_r2c)]
+        first, xpose, last = self._fwd_parts()
+        d1, d2 = self._stage_descs()
+        return self._jit_stages(
+            [(d1, first, self._in_spec, self._in_spec),
+             (self._xpose_desc(), xpose, self._in_spec, self._out_spec),
+             (d2, last, self._out_spec, self._out_spec)])
+
+    def inverse_stages(self):
+        if self.fft3d:
+            return [(None, self.exec_c2r)]
+        first, xpose, last = self._inv_parts()
+        d1, d2 = self._stage_descs()
+        return self._jit_stages(
+            [(d2, first, self._out_spec, self._out_spec),
+             (self._xpose_desc(), xpose, self._out_spec, self._in_spec),
+             (d1, last, self._in_spec, self._in_spec)])
+
+
 

@@ -1,0 +1,103 @@
+"""Communication microbenchmarks — the analog of the reference's
+``reference`` executable testcases 1-3 (``tests/src/reference/reference.cu``,
+``tests/include/tests_reference.hpp:53-96``), which measure raw exchange
+bandwidth for 1D/2D/3D-strided layouts to attribute transpose cost to memcpy
+shape vs network.
+
+On TPU the pack/exchange/unpack collapse into one collective, so the matrix
+becomes: redistribution strategy (explicit ``lax.all_to_all`` vs
+GSPMD-inserted) x exchange geometry (1D slab-like single transpose vs 2D
+pencil-like transpose over one axis of a 2D mesh). Reported bandwidth is
+*effective* bytes-of-global-array per wall-clock second — the same
+"how fast can we re-distribute this volume" number the reference prints.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.mesh import make_pencil_mesh, make_slab_mesh
+from ..parallel.transpose import all_to_all_transpose
+
+
+def _time_fn(fn, x, iterations: int, warmup: int) -> float:
+    for _ in range(warmup):
+        y = fn(x)
+    jax.block_until_ready(y if warmup else x)
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        y = fn(x)
+    jax.block_until_ready(y)
+    return (time.perf_counter() - t0) / iterations
+
+
+def transpose_bandwidth(shape, p: int, explicit: bool = True,
+                        iterations: int = 10, warmup: int = 2,
+                        dtype=np.float32, pencil_axis: bool = False) -> Dict:
+    """Global-transpose bandwidth over a 1D mesh (slab-like, reference
+    testcase 2 geometry) or one axis of a 2D mesh (pencil-like, testcase 3).
+
+    explicit=True  -> shard_map + lax.all_to_all (the All2All path)
+    explicit=False -> GSPMD resharding via jit out_shardings (Peer2Peer path)
+    """
+    if pencil_axis:
+        mesh = make_pencil_mesh(1, p)
+        axis = "p2"
+        in_spec = PartitionSpec(None, axis, None)
+        out_spec = PartitionSpec(None, None, axis)
+        split, concat = 2, 1
+        sharded_exts = (shape[1], shape[2])
+    else:
+        mesh = make_slab_mesh(p)
+        axis = "p"
+        in_spec = PartitionSpec(axis, None, None)
+        out_spec = PartitionSpec(None, axis, None)
+        split, concat = 1, 0
+        sharded_exts = (shape[0], shape[1])
+    for ext in sharded_exts:
+        if ext % p:
+            raise ValueError(
+                f"microbench extents must divide the mesh: {ext} % {p} != 0 "
+                f"(the plan paths pad uneven extents; this raw-bandwidth "
+                f"probe intentionally does not)")
+
+    x = jax.device_put(np.ones(shape, dtype=dtype),
+                       NamedSharding(mesh, in_spec))
+    if explicit:
+        body = jax.shard_map(
+            lambda xl: all_to_all_transpose(xl, axis, split, concat),
+            mesh=mesh, in_specs=in_spec, out_specs=out_spec)
+        fn = jax.jit(body, in_shardings=NamedSharding(mesh, in_spec),
+                     out_shardings=NamedSharding(mesh, out_spec))
+    else:
+        fn = jax.jit(lambda a: a, in_shardings=NamedSharding(mesh, in_spec),
+                     out_shardings=NamedSharding(mesh, out_spec))
+    dt = _time_fn(fn, x, iterations, warmup)
+    nbytes = np.prod(shape) * np.dtype(dtype).itemsize
+    return {"seconds": dt, "bytes": int(nbytes),
+            "gb_per_s": nbytes / dt / 1e9}
+
+
+def single_device_fft_ms(shape, iterations: int = 10, warmup: int = 2,
+                         dtype=np.float32, inverse: bool = False) -> float:
+    """Reference testcase 0 analog: full 3D FFT of ``shape = (nx, ny, nz)``
+    on one device (the cufftMakePlan3d baseline curve). Input is staged on
+    device once."""
+    shape = tuple(shape)
+    x = jax.device_put(np.random.default_rng(0).random(shape).astype(dtype))
+    if inverse:
+        c = jax.jit(lambda a: jnp.fft.rfftn(a))(x)
+        jax.block_until_ready(c)
+        fn = jax.jit(lambda a: jnp.fft.irfftn(a, s=shape))
+        dt = _time_fn(fn, c, iterations, warmup)
+    else:
+        fn = jax.jit(lambda a: jnp.fft.rfftn(a))
+        dt = _time_fn(fn, x, iterations, warmup)
+    return dt * 1e3

@@ -31,9 +31,13 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
                          "transform) layout")
     ap.add_argument("--iterations", "-i", type=int, default=1)
     ap.add_argument("--warmup-rounds", "-w", type=int, default=0)
-    ap.add_argument("--cuda_aware", "-c", action="store_true",
+    ap.add_argument("--cuda_aware", "-c", action="store_true", default=True,
                     help="accepted for reference CLI compatibility; "
-                         "device-resident collectives are always on for TPU")
+                         "device-resident collectives are always on for TPU "
+                         "(default true, matching Config.cuda_aware, so CLI "
+                         "and library runs share one CSV namespace)")
+    ap.add_argument("--host-staged", dest="cuda_aware", action="store_false",
+                    help="label this run as host-staged (cuda=0 in CSV names)")
     ap.add_argument("--double_prec", "-d", action="store_true",
                     help="use float64/complex128 (CPU backend only; TPU has "
                          "no native f64)")

@@ -1,0 +1,297 @@
+"""Benchmark evaluation — the analog of the reference's eval layer (L7).
+
+Reduces raw Timer CSVs (reference schema, see ``utils/timer.py``) into the
+reference's reduced formats (``eval/global_redist/evaluation_slab.py``,
+``evaluation_pencil.py``, ``eval/complete/plot_complete.py``):
+
+* ``<out>/<variant>/runs/runs_<opt>_<P>_<cuda>.csv`` — header ``,,size...``,
+  one ``comm,snd,means...`` row per strategy (mean "Run complete" ms);
+* ``<out>/<variant>/sd/sd_<opt>_<P>_<cuda>.csv`` — same layout, standard
+  deviations;
+* ``<out>/proportions_<P>_<cuda>.csv`` — per variant: best strategy per
+  size and each phase's share of "Run complete" for that strategy;
+* ``<out>/results_<P>.csv`` — per (variant, opt) a row triple
+  (CI low / mean / CI high) of "Run complete" across sizes, the format the
+  reference's ``plot_complete.py`` emits (``results_{P}.csv``);
+* optional matplotlib comparison plot when available.
+
+Confidence intervals use the Student-t 95% interval like the reference
+(``evaluation_slab.py`` via ``scipy.stats.t``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..utils.timer import read_timer_csv
+
+# Slab: test_<opt>_<comm>_<snd>_<Nx>_<Ny>_<Nz>_<cuda>_<P>.csv
+# Pencil: test_<opt>_<comm1>_<snd1>_<comm2>_<snd2>_<Nx>_<Ny>_<Nz>_<cuda>_<P1>_<P2>.csv
+_SLAB_FILE_RE = re.compile(
+    r"test_(?P<opt>\d+)_(?P<comm>\d+)_(?P<snd>\d+)_(?P<nx>\d+)_(?P<ny>\d+)"
+    r"_(?P<nz>\d+)_(?P<cuda>\d+)_(?P<p>\d+)\.csv$")
+_PENCIL_FILE_RE = re.compile(
+    r"test_(?P<opt>\d+)_(?P<comm>\d+)_(?P<snd>\d+)_(?P<comm2>\d+)"
+    r"_(?P<snd2>\d+)_(?P<nx>\d+)_(?P<ny>\d+)_(?P<nz>\d+)_(?P<cuda>\d+)"
+    r"_(?P<p1>\d+)_(?P<p2>\d+)\.csv$")
+
+_COMM_NAMES = {0: "Peer2Peer", 1: "All2All"}
+_SND_NAMES = {0: "Sync", 1: "Streams", 2: "MPI_Type"}
+
+_VARIANT_LABELS = {
+    "slab_default": ("Slab", "2D-1D"),
+    "slab_z_then_yx": ("Slab", "1D-2D"),
+    "slab_y_then_zx": ("Slab", "1D-2D-Y"),
+    "pencil": ("Pencil", ""),
+}
+
+
+def _t_ci(values: np.ndarray, conf: float = 0.95) -> Tuple[float, float, float]:
+    """(low, mean, high) Student-t confidence interval, reference-style."""
+    m = float(np.mean(values))
+    if len(values) < 2:
+        return (m, m, m)
+    sd = float(np.std(values, ddof=1))
+    try:
+        from scipy import stats
+        h = sd / np.sqrt(len(values)) * stats.t.ppf((1 + conf) / 2, len(values) - 1)
+    except ImportError:
+        h = 1.96 * sd / np.sqrt(len(values))
+    return (float(m - h), m, float(m + h))
+
+
+def scan(prefix: str) -> Dict:
+    """Collect raw Timer CSVs:
+    {variant: {(opt, comm, snd, cuda, P): {size_label: blocks}}}."""
+    data: Dict = defaultdict(lambda: defaultdict(dict))
+    for variant in sorted(os.listdir(prefix)):
+        vdir = os.path.join(prefix, variant)
+        if not os.path.isdir(vdir):
+            continue
+        for fname in sorted(os.listdir(vdir)):
+            m = _PENCIL_FILE_RE.match(fname) or _SLAB_FILE_RE.match(fname)
+            if not m:
+                continue
+            g = {k: int(v) for k, v in m.groupdict().items()}
+            size = f"{g['nx']}_{g['ny']}_{g['nz']}"
+            p = g.get("p", g.get("p1", 1) * g.get("p2", 1))
+            # pencil strategy identity includes the second transpose
+            comm = (g["comm"], g["comm2"]) if "comm2" in g else g["comm"]
+            snd = (g["snd"], g["snd2"]) if "snd2" in g else g["snd"]
+            key = (g["opt"], comm, snd, g["cuda"], p)
+            data[variant][key][size] = read_timer_csv(os.path.join(vdir, fname))
+    return data
+
+
+def _run_complete(blocks) -> np.ndarray:
+    return np.array([b["Run complete"][0] for b in blocks
+                     if "Run complete" in b])
+
+
+def _phase_durations(blocks) -> Dict[str, float]:
+    """Mean per-phase durations from the cumulative timeline markers: each
+    stored section's duration is its mark minus the largest earlier mark
+    (sections never stored contribute 0)."""
+    sums: Dict[str, List[float]] = defaultdict(list)
+    for b in blocks:
+        marks = [(d, v[0]) for d, v in b.items() if v and v[0] > 0.0]
+        marks.sort(key=lambda kv: kv[1])
+        prev = 0.0
+        for desc, mark in marks:
+            if desc == "Run complete":
+                continue
+            sums[desc].append(mark - prev)
+            prev = mark
+    return {d: float(np.mean(v)) for d, v in sums.items()}
+
+
+def _size_sort_key(label: str):
+    return tuple(int(t) for t in label.split("_"))
+
+
+def _strategy_names(comm, snd):
+    """Human strategy labels; pencil strategies are (t1, t2) tuples joined
+    with '+' when the two transposes differ."""
+    def one(table, v):
+        if isinstance(v, tuple):
+            a, b = table[v[0]], table[v[1]]
+            return a if a == b else f"{a}+{b}"
+        return table[v]
+    return one(_COMM_NAMES, comm), one(_SND_NAMES, snd)
+
+
+def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
+    data = scan(prefix)
+    if not data:
+        print(f"no Timer CSVs found under {prefix}", file=sys.stderr)
+        return
+    os.makedirs(out, exist_ok=True)
+
+    # union of sizes per (P, cuda) across variants, for results files
+    results_rows: Dict[int, List[Tuple[str, int, List]]] = defaultdict(list)
+    proportions: Dict[Tuple[int, int], List[str]] = defaultdict(list)
+
+    for variant, combos in data.items():
+        vlabel = _VARIANT_LABELS.get(variant, (variant, ""))
+        by_opc: Dict[Tuple[int, int, int], Dict] = defaultdict(dict)
+        for (opt, comm, snd, cuda, p), sizes in combos.items():
+            by_opc[(opt, cuda, p)][(comm, snd)] = sizes
+
+        for (opt, cuda, p), strategies in sorted(by_opc.items()):
+            all_sizes = sorted({s for szs in strategies.values() for s in szs},
+                               key=_size_sort_key)
+            runs_dir = os.path.join(out, variant, "runs")
+            sd_dir = os.path.join(out, variant, "sd")
+            os.makedirs(runs_dir, exist_ok=True)
+            os.makedirs(sd_dir, exist_ok=True)
+            header = ",," + ",".join(all_sizes)
+            runs_lines, sd_lines = [header], [header]
+            best_per_size: Dict[str, Tuple[float, Tuple[int, int]]] = {}
+            ci_per_size: Dict[str, Tuple[float, float, float]] = {}
+            for (comm, snd), sizes in sorted(strategies.items()):
+                means, sds = [], []
+                for s in all_sizes:
+                    if s not in sizes:
+                        means.append("")
+                        sds.append("")
+                        continue
+                    rc = _run_complete(sizes[s])
+                    lo, m, hi = _t_ci(rc)
+                    means.append(repr(m))
+                    sds.append(repr(float(np.std(rc, ddof=1))
+                                    if len(rc) > 1 else 0.0))
+                    if s not in best_per_size or m < best_per_size[s][0]:
+                        best_per_size[s] = (m, (comm, snd))
+                        ci_per_size[s] = (lo, m, hi)
+                cname, sname = _strategy_names(comm, snd)
+                runs_lines.append(f"{cname},{sname}," + ",".join(means))
+                sd_lines.append(f"{cname},{sname}," + ",".join(sds))
+            with open(os.path.join(runs_dir, f"runs_{opt}_{p}_{cuda}.csv"),
+                      "w") as f:
+                f.write("\n".join(runs_lines) + "\n")
+            with open(os.path.join(sd_dir, f"sd_{opt}_{p}_{cuda}.csv"),
+                      "w") as f:
+                f.write("\n".join(sd_lines) + "\n")
+
+            # results triples: best strategy's CI per size
+            label = ",".join(filter(None, [*vlabel,
+                                           "Realigned" if opt else "Default"]))
+            triple = [[], [], []]
+            for s in all_sizes:
+                lo, m, hi = ci_per_size.get(s, (np.nan,) * 3)
+                for i, v in enumerate((lo, m, hi)):
+                    triple[i].append(repr(v))
+            results_rows[p].append((label, cuda, triple))
+
+            # proportions for the best strategy per size
+            prop_lines = [label, "," + ",".join(all_sizes)]
+            best_names = []
+            per_size_props: List[Dict[str, float]] = []
+            phases_seen: List[str] = []
+            for s in all_sizes:
+                _, (comm, snd) = best_per_size[s]
+                cname, sname = _strategy_names(comm, snd)
+                best_names.append(f"{cname}_{sname}")
+                blocks = strategies[(comm, snd)][s]
+                durs = _phase_durations(blocks)
+                total = float(np.mean(_run_complete(blocks))) or 1.0
+                per_size_props.append({d: v / total for d, v in durs.items()})
+                for d in durs:
+                    if d not in phases_seen:
+                        phases_seen.append(d)
+            prop_lines.append("," + ",".join(best_names))
+            for d in phases_seen:
+                vals = [repr(props.get(d, 0.0)) for props in per_size_props]
+                prop_lines.append(d.replace(" ", "_").replace(",", "") + ","
+                                  + ",".join(vals))
+            proportions[(p, cuda)] += prop_lines + [""]
+
+    for (p, cuda), lines in proportions.items():
+        with open(os.path.join(out, f"proportions_{p}_{cuda}.csv"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+    for p, rows in results_rows.items():
+        multiple_cuda = len({cuda for _, cuda, _ in rows}) > 1
+        with open(os.path.join(out, f"results_{p}.csv"), "w") as f:
+            f.write(f"TPU P={p}\n")
+            for label, cuda, triple in rows:
+                if multiple_cuda:
+                    label = f"{label},cuda{cuda}"
+                for vals in triple:
+                    f.write(label + "," + ",".join(vals) + "\n")
+    if make_plots:
+        _plot(results_rows, out)
+
+
+def _plot(results_rows, out: str) -> None:
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; skipping plots", file=sys.stderr)
+        return
+    for p, rows in results_rows.items():
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for label, cuda, triple in rows:
+            means = [float(v) if v != "nan" else np.nan for v in triple[1]]
+            ax.plot(range(len(means)), means, marker="o", label=label)
+        ax.set_yscale("log")
+        ax.set_xlabel("size index")
+        ax.set_ylabel("Run complete [ms]")
+        ax.set_title(f"P={p}")
+        ax.legend(fontsize=7)
+        fig.savefig(os.path.join(out, f"comparison_{p}.png"), dpi=120)
+        plt.close(fig)
+
+
+def numerical_results(log_dir: str, out_path: str) -> int:
+    """Parse ``Result`` lines from launcher stdout logs (.out/.txt) into an
+    accuracy table — the analog of ``eval/complete/numerical_results.py``
+    keying on lines containing "Result" after a launcher command echo."""
+    rows = []
+    for fname in sorted(os.listdir(log_dir)):
+        if not (fname.endswith(".out") or fname.endswith(".txt")):
+            continue
+        last_cmd = ""
+        with open(os.path.join(log_dir, fname)) as f:
+            for line in f:
+                line = line.strip()
+                if "distributedfft_tpu.cli" in line:
+                    last_cmd = line
+                elif line.startswith("Result") and last_cmd:
+                    rows.append((fname, last_cmd, line))
+    with open(out_path, "w") as f:
+        f.write("log,command,result\n")
+        for r in rows:
+            f.write(",".join('"%s"' % c.replace('"', "'") for c in r) + "\n")
+    return len(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--prefix", required=True,
+                    help="benchmark dir holding <variant>/test_*.csv files")
+    ap.add_argument("--out", default=None,
+                    help="output dir (default: <prefix>/eval)")
+    ap.add_argument("--plots", action="store_true")
+    ap.add_argument("--logs", default=None,
+                    help="also parse Result lines from this log dir")
+    args = ap.parse_args(argv)
+    out = args.out or os.path.join(args.prefix, "eval")
+    reduce_prefix(args.prefix, out, make_plots=args.plots)
+    if args.logs:
+        n = numerical_results(args.logs, os.path.join(out, "numerical_results.csv"))
+        print(f"parsed {n} Result lines")
+    print(f"eval written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

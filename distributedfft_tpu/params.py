@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import math
 from typing import List, Optional, Sequence, Tuple
 
 from .utils import native_planner
@@ -131,11 +130,7 @@ def block_sizes(n: int, p: int) -> List[int]:
 def block_starts(sizes: Sequence[int]) -> List[int]:
     """Exclusive prefix sum -> per-part start offsets
     (reference ``Partition_Dimensions::computeOffsets``, ``params.hpp:58-81``)."""
-    starts, acc = [], 0
-    for s in sizes:
-        starts.append(acc)
-        acc += s
-    return starts
+    return native_planner.block_starts(list(sizes))
 
 
 def even_shard_sizes(n: int, n_pad: int, p: int) -> List[int]:
@@ -144,8 +139,7 @@ def even_shard_sizes(n: int, n_pad: int, p: int) -> List[int]:
     only pad and report 0. This is what the framework's NamedShardings
     actually materialize — distinct from the reference's remainder-spread
     ``block_sizes``."""
-    b = n_pad // p
-    return [max(0, min(b, n - i * b)) for i in range(p)]
+    return native_planner.even_shard_sizes(n, n_pad, p)
 
 
 def padded_extent(n: int, p: int) -> int:
@@ -154,7 +148,7 @@ def padded_extent(n: int, p: int) -> int:
     XLA collectives want equal splits; where the reference uses per-peer byte
     counts for uneven extents (e.g. the odd ``Nz/2+1`` axis), the TPU design
     pads the axis to ``p * ceil(n/p)`` and slices the result (SURVEY §7)."""
-    return p * math.ceil(n / p)
+    return native_planner.padded_extent(n, p)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -55,9 +55,12 @@ def make_timer(plan, write_csv: bool = True) -> Timer:
     cfg = plan.config
     filename = None
     if write_csv:
+        grid = ((plan.p1, plan.p2) if isinstance(plan, PencilFFTPlan)
+                and not plan.fft3d else None)
         filename = benchmark_filename(cfg.benchmark_dir, plan.variant_name,
                                       cfg, plan.global_size,
-                                      plan.partition.num_ranks)
+                                      plan.partition.num_ranks,
+                                      pencil_grid=grid)
     import jax
     return Timer(plan.section_descriptions, plan.partition.num_ranks, filename,
                  process_index=jax.process_index())
@@ -265,16 +268,17 @@ def _laplacian_scale(plan) -> np.ndarray:
         halved_axis = 1
 
     def folded(n, ext, halved):
+        # Integer-halving fold exactly as the reference kernel: k = i for
+        # i < n//2, k = n - i for i > n//2, and 0 at i == n//2 — including
+        # odd extents, where the reference also zeroes i == n//2
+        # (random_dist_default.cu:80-88: `if (x < Nx/2) ... else if
+        # (x > (int)(Nx/2)) ...`).
         k = np.zeros(ext)
         for i in range(min(n if not halved else n // 2 + 1, ext)):
-            if halved:
-                k[i] = i if i < n // 2 else 0
-            else:
-                if i < n / 2:
-                    k[i] = i
-                elif i > n // 2:
-                    k[i] = n - i
-                # i == n/2 (Nyquist): 0, as in the reference kernel
+            if i < n // 2:
+                k[i] = i
+            elif i > n // 2 and not halved:
+                k[i] = n - i
         return k
 
     dims = [g.nx, g.ny, g.nz]

@@ -4,8 +4,11 @@ The reference computes all partition tables (block extents with remainder
 spread, offsets, per-peer transfer counts) in C++ inside ``initFFT``
 (``src/slab/default/mpicufft_slab.cpp:112-128,183-229``). The TPU framework
 keeps that layer native as well: ``native/planner.cpp`` builds
-``libdfft_planner.so`` and this module binds it via ``ctypes`` with a pure
-Python fallback, so the package works before the native lib is built.
+``libdfft_planner.so`` (``make -C native``) and this module binds it via
+``ctypes`` with pure-Python fallbacks, so the package works before the
+native lib is built. ``using_native()`` reports which path is active;
+``DFFT_PLANNER_LIB`` overrides the library path, ``DFFT_NO_NATIVE=1``
+forces the Python fallbacks.
 """
 
 from __future__ import annotations
@@ -24,6 +27,8 @@ def _lib() -> Optional[ctypes.CDLL]:
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
+    if os.environ.get("DFFT_NO_NATIVE"):
+        return None
     here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     candidates = [
         os.path.join(here, "native", "build", "libdfft_planner.so"),
@@ -33,17 +38,32 @@ def _lib() -> Optional[ctypes.CDLL]:
     if env:
         candidates.insert(0, env)
     for path in candidates:
-        if os.path.exists(path):
-            try:
-                lib = ctypes.CDLL(path)
-                lib.dfft_block_sizes.argtypes = [
-                    ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
-                lib.dfft_block_sizes.restype = ctypes.c_int
-                _LIB = lib
-                break
-            except OSError:
-                continue
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+            i64 = ctypes.c_int64
+            p64 = ctypes.POINTER(ctypes.c_int64)
+            lib.dfft_block_sizes.argtypes = [i64, i64, p64]
+            lib.dfft_block_sizes.restype = ctypes.c_int
+            lib.dfft_block_starts.argtypes = [p64, i64, p64]
+            lib.dfft_block_starts.restype = ctypes.c_int
+            lib.dfft_padded_extent.argtypes = [i64, i64]
+            lib.dfft_padded_extent.restype = i64
+            lib.dfft_even_shard_sizes.argtypes = [i64, i64, i64, p64]
+            lib.dfft_even_shard_sizes.restype = ctypes.c_int
+            lib.dfft_transpose_wire_bytes.argtypes = [i64, i64, i64, i64, i64]
+            lib.dfft_transpose_wire_bytes.restype = i64
+        except (OSError, AttributeError):
+            # missing file or stale .so lacking a symbol: fall back to Python
+            continue
+        _LIB = lib
+        break
     return _LIB
+
+
+def using_native() -> bool:
+    return _lib() is not None
 
 
 def block_sizes(n: int, p: int) -> List[int]:
@@ -62,5 +82,57 @@ def block_sizes(n: int, p: int) -> List[int]:
     return [base + 1 if i < rem else base for i in range(p)]
 
 
-def using_native() -> bool:
-    return _lib() is not None
+def block_starts(sizes: List[int]) -> List[int]:
+    """Exclusive prefix sum (reference ``computeOffsets``)."""
+    lib = _lib()
+    p = len(sizes)
+    if lib is not None and p:
+        arr = (ctypes.c_int64 * p)(*sizes)
+        out = (ctypes.c_int64 * p)()
+        if lib.dfft_block_starts(arr, p, out) == 0:
+            return list(out)
+    starts, acc = [], 0
+    for s in sizes:
+        starts.append(acc)
+        acc += s
+    return starts
+
+
+def padded_extent(n: int, p: int) -> int:
+    """Smallest multiple of ``p`` >= ``n`` (XLA even-shard pad target)."""
+    if p <= 0:
+        raise ValueError(f"partition count must be positive, got {p}")
+    lib = _lib()
+    if lib is not None:
+        v = lib.dfft_padded_extent(n, p)
+        if v >= 0:
+            return int(v)
+    return p * math.ceil(n / p)
+
+
+def even_shard_sizes(n: int, n_pad: int, p: int) -> List[int]:
+    """Logical per-rank extents under even padded sharding."""
+    if p <= 0:
+        raise ValueError(f"partition count must be positive, got {p}")
+    lib = _lib()
+    if lib is not None:
+        out = (ctypes.c_int64 * p)()
+        if lib.dfft_even_shard_sizes(n, n_pad, p, out) == 0:
+            return list(out)
+    b = n_pad // p
+    return [max(0, min(b, n - i * b)) for i in range(p)]
+
+
+def transpose_wire_bytes(shape, p: int, itemsize: int) -> int:
+    """Bytes crossing the interconnect in one all_to_all global transpose of
+    a padded volume over ``p`` devices (diagonal block stays local) — the
+    payload the reference tabulates per-peer for Alltoallv
+    (``src/slab/default/mpicufft_slab.cpp:217-228``)."""
+    d0, d1, d2 = shape
+    lib = _lib()
+    if lib is not None:
+        v = lib.dfft_transpose_wire_bytes(d0, d1, d2, p, itemsize)
+        if v >= 0:
+            return int(v)
+    total = d0 * d1 * d2 * itemsize
+    return total - total // p

@@ -30,13 +30,26 @@ _SEND_CODE = {SendMethod.SYNC: 0, SendMethod.STREAMS: 1, SendMethod.MPI_TYPE: 2}
 
 
 def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
-                       global_size: GlobalSize, pcnt: int) -> str:
-    """Reference-compatible CSV path (mpicufft_slab.cpp:99-103)."""
+                       global_size: GlobalSize, pcnt: int,
+                       pencil_grid=None) -> str:
+    """Reference-compatible CSV path. Slab scheme
+    (mpicufft_slab.cpp:99-103):
+    ``test_<opt>_<comm>_<snd>_<Nx>_<Ny>_<Nz>_<cuda>_<P>.csv``; pencil adds
+    the second-transpose strategy and the grid
+    (mpicufft_pencil.cpp:69-71):
+    ``test_<opt>_<comm1>_<snd1>_<comm2>_<snd2>_<dims>_<cuda>_<P1>_<P2>.csv``."""
     comm = _COMM_CODE[config.comm_method]
     snd = _SEND_CODE[config.send_method]
     cuda = 1 if config.cuda_aware else 0
     g = global_size
     d = os.path.join(benchmark_dir, variant)
+    if pencil_grid is not None:
+        comm2 = _COMM_CODE[config.resolved_comm2()]
+        snd2 = _SEND_CODE[config.resolved_snd2()]
+        p1, p2 = pencil_grid
+        return os.path.join(
+            d, f"test_{config.opt}_{comm}_{snd}_{comm2}_{snd2}"
+               f"_{g.nx}_{g.ny}_{g.nz}_{cuda}_{p1}_{p2}.csv")
     return os.path.join(
         d, f"test_{config.opt}_{comm}_{snd}_{g.nx}_{g.ny}_{g.nz}_{cuda}_{pcnt}.csv")
 

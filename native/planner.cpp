@@ -1,0 +1,66 @@
+// Host-side partition planner for distributedfft_tpu.
+//
+// The reference computes all partition bookkeeping natively inside its C++
+// plan classes: block extents with remainder spread
+// (src/slab/default/mpicufft_slab.cpp:112-128), prefix offsets
+// (include/params.hpp:58-81 computeOffsets) and per-peer transfer byte
+// tables (src/slab/default/mpicufft_slab.cpp:183-229). This library keeps
+// that layer native for the TPU framework; Python binds it via ctypes
+// (distributedfft_tpu/utils/native_planner.py) with a pure-Python fallback.
+//
+// Build: make -C native    (produces native/build/libdfft_planner.so)
+
+#include <cstdint>
+
+extern "C" {
+
+// Block distribution of n items over p parts, remainder spread over the
+// first parts (reference-compatible). Returns 0 on success.
+int dfft_block_sizes(int64_t n, int64_t p, int64_t *out) {
+    if (p <= 0 || n < 0 || out == nullptr) return 1;
+    const int64_t base = n / p;
+    const int64_t rem = n % p;
+    for (int64_t i = 0; i < p; ++i) out[i] = base + (i < rem ? 1 : 0);
+    return 0;
+}
+
+// Exclusive prefix sum -> start offsets (computeOffsets analog).
+int dfft_block_starts(const int64_t *sizes, int64_t p, int64_t *out) {
+    if (p <= 0 || sizes == nullptr || out == nullptr) return 1;
+    int64_t acc = 0;
+    for (int64_t i = 0; i < p; ++i) { out[i] = acc; acc += sizes[i]; }
+    return 0;
+}
+
+// Smallest multiple of p >= n (the XLA even-shard pad target).
+int64_t dfft_padded_extent(int64_t n, int64_t p) {
+    if (p <= 0) return -1;
+    return ((n + p - 1) / p) * p;
+}
+
+// Logical per-rank extents under even padded sharding: ceil blocks of the
+// padded axis, ranks past the logical extent hold only pad (report 0).
+int dfft_even_shard_sizes(int64_t n, int64_t n_pad, int64_t p, int64_t *out) {
+    if (p <= 0 || n < 0 || n_pad < n || n_pad % p != 0 || out == nullptr)
+        return 1;
+    const int64_t b = n_pad / p;
+    for (int64_t i = 0; i < p; ++i) {
+        int64_t left = n - i * b;
+        out[i] = left < 0 ? 0 : (left < b ? left : b);
+    }
+    return 0;
+}
+
+// Bytes moved through one all_to_all global transpose of a padded
+// (d0, d1, d2) volume split over p along split_axis: every device exchanges
+// its full shard except the diagonal block that stays local — the payload
+// the reference tabulates per-peer for Alltoallv
+// (src/slab/default/mpicufft_slab.cpp:217-228).
+int64_t dfft_transpose_wire_bytes(int64_t d0, int64_t d1, int64_t d2,
+                                  int64_t p, int64_t itemsize) {
+    if (p <= 0 || itemsize <= 0) return -1;
+    const int64_t total = d0 * d1 * d2 * itemsize;
+    return total - total / p;  // diagonal block stays on-device
+}
+
+}  // extern "C"

@@ -1,0 +1,110 @@
+"""Tests for the job launcher (L6) and eval reducer (L7)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import launch  # noqa: E402  (repo-root module, like the reference's launch.py)
+
+from distributedfft_tpu.evalkit import evaluate  # noqa: E402
+from distributedfft_tpu.utils.timer import Timer  # noqa: E402
+
+
+class TestLauncher:
+    def test_merge_flags_precedence(self):
+        job = {"global_test_settings": {"-i": 5, "$-t": 4}}
+        test = {"name": "Slab", "-comm": "All2All"}
+        merged = launch.merge_flags(job, test, {"-i": "20", "-t": "0"})
+        # plain keys overridden by CLI; $-escaped keys resist override
+        assert merged["-i"] == "20"
+        assert merged["-t"] == 4
+        assert merged["-comm"] == "All2All"
+
+    def test_size_flags(self):
+        assert launch.size_flags(128) == ["-nx", "128", "-ny", "128", "-nz", "128"]
+        assert launch.size_flags([128, 256, 512]) == [
+            "-nx", "128", "-ny", "256", "-nz", "512"]
+
+    def test_parse_param_string(self):
+        got = launch.parse_param_string("-i 5 -c -b dir")
+        assert got == {"-i": "5", "-c": True, "-b": "dir"}
+
+    def test_exe_selection(self):
+        assert launch.exe_for_test({"name": "Pencil"}) == "pencil"
+        assert launch.exe_for_test({"name": "Reference"}) == "reference"
+        assert launch.exe_for_test({"name": "Slab"}) == "slab"
+
+    def test_dry_run_end_to_end(self, tmp_path, capsys):
+        job = {"size": [16], "global_test_settings": {"-i": 1},
+               "tests": [{"name": "Slab", "-comm": "All2All"}]}
+        path = tmp_path / "job.json"
+        path.write_text(json.dumps(job))
+        rc = launch.main(["--jobs", str(path), "--dry-run",
+                          "--emulate-devices", "8"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "distributedfft_tpu.cli.slab" in out
+        assert "-nx 16 -ny 16 -nz 16" in out
+
+
+def _write_fake_csvs(bench_dir, variant, combos, sizes, iters=3, seed=0):
+    rng = np.random.default_rng(seed)
+    descs = ["init", "first", "xpose", "last", "Run complete"]
+    for (opt, comm, snd) in combos:
+        for (nx, ny, nz) in sizes:
+            fname = f"test_{opt}_{comm}_{snd}_{nx}_{ny}_{nz}_0_8.csv"
+            t = Timer(descs, 8, os.path.join(bench_dir, variant, fname))
+            for _ in range(iters):
+                t.start()
+                base = 1.0 + rng.random()
+                t._durations = {"first": base, "xpose": base * 2,
+                                "last": base * 3, "Run complete": base * 3.1}
+                t.gather()
+
+
+class TestEvalKit:
+    def test_reduce_outputs(self, tmp_path):
+        bench = str(tmp_path / "bench")
+        _write_fake_csvs(bench, "slab_default",
+                         [(0, 0, 0), (0, 1, 0), (1, 1, 0)],
+                         [(16, 16, 16), (16, 16, 32)])
+        out = str(tmp_path / "eval")
+        evaluate.reduce_prefix(bench, out)
+        runs = open(os.path.join(out, "slab_default", "runs",
+                                 "runs_0_8_0.csv")).read().splitlines()
+        assert runs[0] == ",,16_16_16,16_16_32"
+        assert runs[1].startswith("Peer2Peer,Sync,")
+        assert runs[2].startswith("All2All,Sync,")
+        results = open(os.path.join(out, "results_8.csv")).read().splitlines()
+        # one triple per (variant, opt): 2 opts -> 6 data rows + title
+        assert len(results) == 7
+        assert results[1].startswith("Slab,2D-1D,Default,")
+        assert results[4].startswith("Slab,2D-1D,Realigned,")
+        # mean row between CI rows
+        lo, m, hi = (float(results[i].split(",")[3]) for i in (1, 2, 3))
+        assert lo <= m <= hi
+        props = open(os.path.join(out, "proportions_8_0.csv")).read()
+        assert "first," in props and "xpose," in props
+
+    def test_phase_durations_from_cumulative_marks(self):
+        blocks = [{"first": [2.0], "xpose": [5.0], "last": [6.0],
+                   "Run complete": [6.1]}]
+        d = evaluate._phase_durations(blocks)
+        assert d["first"] == 2.0
+        assert d["xpose"] == 3.0
+        assert d["last"] == 1.0
+
+    def test_numerical_results(self, tmp_path):
+        log = tmp_path / "run.out"
+        log.write_text(
+            "+ python -m distributedfft_tpu.cli.slab -nx 16 -t 4\n"
+            "Result (avg): 1e-12\nResult (max): 3e-12\n")
+        out = str(tmp_path / "num.csv")
+        n = evaluate.numerical_results(str(tmp_path), out)
+        assert n == 2
+        assert "Result (avg)" in open(out).read()

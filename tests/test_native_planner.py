@@ -1,0 +1,56 @@
+"""Native C++ planner vs pure-Python fallback parity (native/planner.cpp
+bound via ctypes in utils/native_planner.py)."""
+
+import os
+import subprocess
+
+import pytest
+
+from distributedfft_tpu.utils import native_planner as npl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "native", "build", "libdfft_planner.so")
+
+
+def _python_fallback(fn_name, *args):
+    """Run the same helper with the native lib disabled, in-process via a
+    fresh env in a subprocess (module-level cache prevents toggling)."""
+    code = (
+        "import os; os.environ['DFFT_NO_NATIVE']='1';"
+        "from distributedfft_tpu.utils import native_planner as n;"
+        f"print(repr(n.{fn_name}(*{args!r})))"
+    )
+    out = subprocess.run(["python", "-c", code], capture_output=True,
+                         text=True, cwd=REPO, check=True)
+    return eval(out.stdout.strip())  # noqa: S307 - trusted repr output
+
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="native planner not built (make -C native)")
+class TestNativeParity:
+    def test_native_active(self):
+        assert npl.using_native()
+
+    @pytest.mark.parametrize("n,p", [(10, 4), (7, 3), (0, 2), (1024, 64), (5, 8)])
+    def test_block_sizes(self, n, p):
+        assert npl.block_sizes(n, p) == _python_fallback("block_sizes", n, p)
+        assert sum(npl.block_sizes(n, p)) == n
+
+    def test_block_starts(self):
+        assert npl.block_starts([3, 3, 2, 2]) == [0, 3, 6, 8]
+
+    @pytest.mark.parametrize("n,p", [(17, 8), (16, 8), (1, 8), (513, 4)])
+    def test_padded_extent(self, n, p):
+        v = npl.padded_extent(n, p)
+        assert v % p == 0 and v >= n and v - n < p
+
+    @pytest.mark.parametrize("n,n_pad,p", [(17, 24, 8), (16, 16, 8), (5, 8, 8)])
+    def test_even_shard_sizes(self, n, n_pad, p):
+        got = npl.even_shard_sizes(n, n_pad, p)
+        assert got == _python_fallback("even_shard_sizes", n, n_pad, p)
+        assert sum(got) == n
+
+    def test_transpose_wire_bytes(self):
+        # 8 devices: 7/8 of the volume crosses the wire (diagonal stays).
+        total = 16 * 16 * 9 * 8
+        assert npl.transpose_wire_bytes((16, 16, 9), 8, 8) == total - total // 8

@@ -30,15 +30,18 @@ from .parallel.mesh import (
     make_slab_mesh,
 )
 from .models.base import DistFFTPlan
+from .models.batched2d import Batched2DFFTPlan
 from .models.pencil import PencilFFTPlan
 from .models.slab import SlabFFTPlan
+from .solvers.poisson import PoissonSolver
 
 __all__ = [
     "CommMethod", "Config", "FFTNorm", "GlobalSize", "PartitionDims",
     "PencilPartition", "SendMethod", "SlabPartition", "SlabSequence",
     "block_sizes", "block_starts", "padded_extent",
     "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
-    "make_slab_mesh", "DistFFTPlan", "PencilFFTPlan", "SlabFFTPlan",
+    "make_slab_mesh", "Batched2DFFTPlan", "DistFFTPlan", "PencilFFTPlan",
+    "PoissonSolver", "SlabFFTPlan",
 ]
 
 __version__ = "0.1.0"

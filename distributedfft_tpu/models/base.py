@@ -131,6 +131,19 @@ class DistFFTPlan:
 
         return jax.jit(run)
 
+    def _fft3d_c2c(self, forward: bool):
+        """Single-device full 3D C2C (both directions unnormalized under
+        FFTNorm.NONE, like cuFFT's CUFFT_FORWARD/CUFFT_INVERSE)."""
+        norm = self.config.norm
+        axes = (-3, -2, -1)
+
+        def run(c):
+            if forward:
+                return local_fft.fftn(c, axes, norm=norm)
+            return local_fft.ifftn(c, axes, norm=norm)
+
+        return jax.jit(run)
+
     # -- staged-execution helper (shared by slab/pencil) -------------------
 
     def _jit_stages(self, specs):

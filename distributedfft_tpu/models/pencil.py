@@ -62,7 +62,8 @@ class PencilFFTPlan(DistFFTPlan):
     """Distributed 3D R2C/C2R FFT with 2D (pencil) decomposition over (x, y)."""
 
     def __init__(self, global_size: pm.GlobalSize, partition: pm.PencilPartition,
-                 config: Optional[pm.Config] = None, mesh: Optional[Mesh] = None):
+                 config: Optional[pm.Config] = None, mesh: Optional[Mesh] = None,
+                 transform: str = "r2c"):
         if mesh is None and partition.num_ranks > 1:
             mesh = make_pencil_mesh(partition.p1, partition.p2)
         if mesh is not None and partition.num_ranks > 1:
@@ -75,18 +76,24 @@ class PencilFFTPlan(DistFFTPlan):
                         f"mesh axis {name!r} has {mesh.shape[name]} devices but "
                         f"the partition asks for {want}")
         super().__init__(global_size, partition, config, mesh)
+        if transform not in ("r2c", "c2c"):
+            raise ValueError(f"transform must be 'r2c' or 'c2c', got {transform!r}")
+        self.transform = transform
         g = global_size
         self.p1, self.p2 = partition.p1, partition.p2
+        # Spectral z extent: halved for R2C, full for C2C (extension; the
+        # reference core is R2C/C2R-only, BASELINE configs #1/#2 need C2C).
+        self._nz_spec = g.nz if transform == "c2c" else g.nz_out
         if self.fft3d:
             self._nx_p1 = g.nx
             self._ny_p2 = g.ny
             self._ny_p1 = g.ny
-            self._nzc_p2 = g.nz_out
+            self._nzc_p2 = self._nz_spec
         else:
             self._nx_p1 = pm.padded_extent(g.nx, self.p1)
             self._ny_p2 = pm.padded_extent(g.ny, self.p2)
             self._ny_p1 = pm.padded_extent(g.ny, self.p1)
-            self._nzc_p2 = pm.padded_extent(g.nz_out, self.p2)
+            self._nzc_p2 = pm.padded_extent(self._nz_spec, self.p2)
             self._in_spec = PartitionSpec(P1_AXIS, P2_AXIS, None)
             self._mid_spec = PartitionSpec(P1_AXIS, None, P2_AXIS)
             self._out_spec = PartitionSpec(None, P1_AXIS, P2_AXIS)
@@ -104,14 +111,14 @@ class PencilFFTPlan(DistFFTPlan):
     @property
     def output_shape(self) -> Tuple[int, int, int]:
         g = self.global_size
-        return (g.nx, g.ny, g.nz_out)
+        return (g.nx, g.ny, self._nz_spec)
 
     def output_padded_shape_for(self, dims: int = 3) -> Tuple[int, int, int]:
         g = self.global_size
         if self.fft3d:
-            return (g.nx, g.ny, g.nz_out)
+            return (g.nx, g.ny, self._nz_spec)
         if dims == 1:
-            return (self._nx_p1, self._ny_p2, g.nz_out)
+            return (self._nx_p1, self._ny_p2, self._nz_spec)
         if dims == 2:
             return (self._nx_p1, g.ny, self._nzc_p2)
         return (g.nx, self._ny_p1, self._nzc_p2)
@@ -153,12 +160,12 @@ class PencilFFTPlan(DistFFTPlan):
             return pm.PartitionDims(
                 tuple(pm.even_shard_sizes(g.nx, self._nx_p1, self.p1)),
                 (g.ny,),
-                tuple(pm.even_shard_sizes(g.nz_out, self._nzc_p2, self.p2)))
+                tuple(pm.even_shard_sizes(self._nz_spec, self._nzc_p2, self.p2)))
         if stage == "output":
             return pm.PartitionDims(
                 (g.nx,),
                 tuple(pm.even_shard_sizes(g.ny, self._ny_p1, self.p1)),
-                tuple(pm.even_shard_sizes(g.nz_out, self._nzc_p2, self.p2)))
+                tuple(pm.even_shard_sizes(self._nz_spec, self._nzc_p2, self.p2)))
         raise ValueError(f"unknown stage {stage!r}")
 
     # -- logical <-> padded helpers ---------------------------------------
@@ -183,12 +190,12 @@ class PencilFFTPlan(DistFFTPlan):
             raise ValueError(
                 f"crop_spectral(dims={dims}) expects padded shape {padded}, "
                 f"got {tuple(c.shape)}")
-        return np.asarray(c)[: g.nx, : g.ny, : g.nz_out]
+        return np.asarray(c)[: g.nx, : g.ny, : self._nz_spec]
 
     def pad_spectral(self, c, dims: int = 3):
         g = self.global_size
         tgt = self.output_padded_shape_for(dims)
-        pads = [(0, tgt[i] - s) for i, s in enumerate((g.nx, g.ny, g.nz_out))]
+        pads = [(0, tgt[i] - s) for i, s in enumerate((g.nx, g.ny, self._nz_spec))]
         if any(p[1] for p in pads):
             c = jnp.pad(c, pads)
         if self.mesh is not None:
@@ -197,15 +204,42 @@ class PencilFFTPlan(DistFFTPlan):
 
     # -- execution ---------------------------------------------------------
 
+    def exec_c2c(self, x, dims: int = 3):
+        """Forward 3D (or partial) C2C transform (transform='c2c' plans)."""
+        if self.transform != "c2c":
+            raise TypeError("this plan was built with transform='r2c'; "
+                            "use exec_r2c/exec_c2r")
+        return self._exec_fwd(x, dims)
+
+    def exec_c2c_inv(self, c, dims: int = 3):
+        """Inverse of ``exec_c2c``."""
+        if self.transform != "c2c":
+            raise TypeError("this plan was built with transform='r2c'; "
+                            "use exec_r2c/exec_c2r")
+        return self._exec_inv(c, dims)
+
     def exec_r2c(self, x, dims: int = 3):
         """Forward transform of the first ``dims`` axes (z, then y, then x),
         mirroring the reference's partial-dimension ``execR2C(out, in, d)``."""
+        if self.transform != "r2c":
+            raise TypeError("this plan was built with transform='c2c'; "
+                            "use exec_c2c/exec_c2c_inv")
+        return self._exec_fwd(x, dims)
+
+    def exec_c2r(self, c, dims: int = 3):
+        """Inverse of ``exec_r2c(..., dims)``."""
+        if self.transform != "r2c":
+            raise TypeError("this plan was built with transform='c2c'; "
+                            "use exec_c2c/exec_c2c_inv")
+        return self._exec_inv(c, dims)
+
+    def _exec_fwd(self, x, dims: int = 3):
         if dims not in (1, 2, 3):
             raise ValueError(f"dims must be 1, 2 or 3, got {dims}")
         if tuple(x.shape) not in (self.input_shape, self.input_padded_shape):
             raise ValueError(
-                f"exec_r2c expects global shape {self.input_shape} (or padded "
-                f"{self.input_padded_shape}), got {tuple(x.shape)}")
+                f"forward exec expects global shape {self.input_shape} (or "
+                f"padded {self.input_padded_shape}), got {tuple(x.shape)}")
         if not self.fft3d and tuple(x.shape) == self.input_shape \
                 and self.input_shape != self.input_padded_shape:
             x = self.pad_input(x)
@@ -213,15 +247,14 @@ class PencilFFTPlan(DistFFTPlan):
             self._r2c_d[dims] = self._build_r2c_d(dims)
         return self._r2c_d[dims](x)
 
-    def exec_c2r(self, c, dims: int = 3):
-        """Inverse of ``exec_r2c(..., dims)``."""
+    def _exec_inv(self, c, dims: int = 3):
         if dims not in (1, 2, 3):
             raise ValueError(f"dims must be 1, 2 or 3, got {dims}")
         padded = self.output_padded_shape_for(dims)
         if tuple(c.shape) not in (self.output_shape, padded):
             raise ValueError(
-                f"exec_c2r(dims={dims}) expects global shape {self.output_shape} "
-                f"(or padded {padded}), got {tuple(c.shape)}")
+                f"inverse exec(dims={dims}) expects global shape "
+                f"{self.output_shape} (or padded {padded}), got {tuple(c.shape)}")
         if not self.fft3d and tuple(c.shape) == self.output_shape \
                 and self.output_shape != padded:
             c = self.pad_spectral(c, dims)
@@ -239,9 +272,13 @@ class PencilFFTPlan(DistFFTPlan):
         realigned = self.config.opt == 1
         nzc_p2, ny_p1 = self._nzc_p2, self._ny_p1
         ny, nx = g.ny, g.nx
+        complex_mode = self.transform == "c2c"
 
         def s1(xl):
-            c = lf.rfft(xl, axis=2, norm=norm)
+            if complex_mode:
+                c = lf.fft(xl, axis=2, norm=norm)
+            else:
+                c = lf.rfft(xl, axis=2, norm=norm)
             if dims >= 2:
                 c = pad_axis_to(c, 2, nzc_p2)
             return c
@@ -271,7 +308,8 @@ class PencilFFTPlan(DistFFTPlan):
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
         nx_p1, ny_p2 = self._nx_p1, self._ny_p2
-        ny, nzc, nz = g.ny, g.nz_out, g.nz
+        ny, nzc, nz = g.ny, self._nz_spec, g.nz
+        complex_mode = self.transform == "c2c"
 
         def i3(cl):
             c = lf.ifft(cl, axis=0, norm=norm)
@@ -290,6 +328,8 @@ class PencilFFTPlan(DistFFTPlan):
 
         def i1(cl):
             c = slice_axis_to(cl, 2, nzc)
+            if complex_mode:
+                return lf.ifft(c, axis=2, norm=norm)
             return lf.irfft(c, n=nz, axis=2, norm=norm)
 
         return (i3 if dims >= 3 else None, t2b if dims >= 3 else None,
@@ -361,7 +401,7 @@ class PencilFFTPlan(DistFFTPlan):
         """[(phase desc, jitted stage fn)] for per-phase timed execution
         (always explicit collectives; the fused exec path is unaffected)."""
         if self.fft3d:
-            return [(None, lambda x: self.exec_r2c(x, dims))]
+            return [(None, lambda x: self._exec_fwd(x, dims))]
         s1, t1, s2, t2, s3 = self._fwd_parts(dims)
         specs = [("1D FFT Z-Direction", s1, self._in_spec, self._in_spec)]
         if dims >= 2:
@@ -374,7 +414,7 @@ class PencilFFTPlan(DistFFTPlan):
 
     def inverse_stages(self, dims: int = 3):
         if self.fft3d:
-            return [(None, lambda c: self.exec_c2r(c, dims))]
+            return [(None, lambda c: self._exec_inv(c, dims))]
         i3, t2b, i2, t1b, i1 = self._inv_parts(dims)
         specs = []
         if dims >= 3:
@@ -446,9 +486,13 @@ class PencilFFTPlan(DistFFTPlan):
 
     def _fft3d_r2c_d(self, dims: int):
         norm = self.config.norm
+        complex_mode = self.transform == "c2c"
 
         def run(x):
-            c = lf.rfft(x, axis=2, norm=norm)
+            if complex_mode:
+                c = lf.fft(x, axis=2, norm=norm)
+            else:
+                c = lf.rfft(x, axis=2, norm=norm)
             if dims >= 2:
                 c = lf.fft(c, axis=1, norm=norm)
             if dims >= 3:
@@ -460,12 +504,15 @@ class PencilFFTPlan(DistFFTPlan):
     def _fft3d_c2r_d(self, dims: int):
         norm = self.config.norm
         nz = self.global_size.nz
+        complex_mode = self.transform == "c2c"
 
         def run(c):
             if dims >= 3:
                 c = lf.ifft(c, axis=0, norm=norm)
             if dims >= 2:
                 c = lf.ifft(c, axis=1, norm=norm)
+            if complex_mode:
+                return lf.ifft(c, axis=2, norm=norm)
             return lf.irfft(c, n=nz, axis=2, norm=norm)
 
         return jax.jit(run)

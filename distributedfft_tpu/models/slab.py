@@ -90,7 +90,8 @@ class SlabFFTPlan(DistFFTPlan):
 
     def __init__(self, global_size: pm.GlobalSize, partition: pm.SlabPartition,
                  config: Optional[pm.Config] = None, mesh: Optional[Mesh] = None,
-                 sequence: "pm.SlabSequence | str" = pm.SlabSequence.ZY_THEN_X):
+                 sequence: "pm.SlabSequence | str" = pm.SlabSequence.ZY_THEN_X,
+                 transform: str = "r2c"):
         if mesh is None and partition.p > 1:
             mesh = make_slab_mesh(partition.p)
         if mesh is not None and partition.p > 1:
@@ -102,11 +103,19 @@ class SlabFFTPlan(DistFFTPlan):
                     f"mesh axis {SLAB_AXIS!r} has {mesh.shape[SLAB_AXIS]} devices "
                     f"but the partition asks for {partition.p}")
         super().__init__(global_size, partition, config, mesh)
+        if transform not in ("r2c", "c2c"):
+            raise ValueError(f"transform must be 'r2c' or 'c2c', got {transform!r}")
+        self.transform = transform
         self.sequence = pm.SlabSequence.parse(sequence)
         self._seq = _SEQS[self.sequence]
         g, P = global_size, partition.p
         self._P = P
-        if self._seq.halved == "z":
+        if transform == "c2c":
+            # No halved axis: complex-to-complex keeps the full extents
+            # (BASELINE configs #1/#2 are 3D C2C transforms; the reference
+            # core is R2C/C2R-only, so this is an extension).
+            self._spec_shape = g.shape
+        elif self._seq.halved == "z":
             self._spec_shape = (g.nx, g.ny, g.nz_out)
         else:
             self._spec_shape = (g.nx, g.ny_out, g.nz)
@@ -187,27 +196,58 @@ class SlabFFTPlan(DistFFTPlan):
         sl[self._seq.split_axis] = slice(0, self._split_ext)
         return c[tuple(sl)]
 
-    # -- execution (auto-pad convenience) ---------------------------------
+    # -- execution (thin guarded wrappers over shared impl) ----------------
 
     def exec_r2c(self, x):
+        if self.transform != "r2c":
+            raise TypeError("this plan was built with transform='c2c'; "
+                            "use exec_c2c/exec_c2c_inv")
+        return self._exec_fwd(x)
+
+    def exec_c2r(self, c):
+        if self.transform != "r2c":
+            raise TypeError("this plan was built with transform='c2c'; "
+                            "use exec_c2c/exec_c2c_inv")
+        return self._exec_inv(c)
+
+    def exec_c2c(self, x):
+        """Forward 3D C2C transform (transform='c2c' plans). Same pipeline
+        as R2C with the first-axis transform complex."""
+        if self.transform != "c2c":
+            raise TypeError("this plan was built with transform='r2c'; "
+                            "use exec_r2c/exec_c2r")
+        return self._exec_fwd(x)
+
+    def exec_c2c_inv(self, c):
+        """Inverse 3D C2C transform (transform='c2c' plans)."""
+        if self.transform != "c2c":
+            raise TypeError("this plan was built with transform='r2c'; "
+                            "use exec_r2c/exec_c2r")
+        return self._exec_inv(c)
+
+    def _exec_fwd(self, x):
         if tuple(x.shape) not in (self.input_shape, self.input_padded_shape):
             raise ValueError(
-                f"exec_r2c expects global shape {self.input_shape} (or padded "
-                f"{self.input_padded_shape}), got {tuple(x.shape)}")
+                f"forward exec expects global shape {self.input_shape} (or "
+                f"padded {self.input_padded_shape}), got {tuple(x.shape)}")
         if not self.fft3d and tuple(x.shape) == self.input_shape \
                 and self.input_shape != self.input_padded_shape:
             x = self.pad_input(x)
-        return super().exec_r2c(x)
+        if self._r2c is None:
+            self._r2c = self._build_r2c()
+        return self._r2c(x)
 
-    def exec_c2r(self, c):
+    def _exec_inv(self, c):
         if tuple(c.shape) not in (self.output_shape, self.output_padded_shape):
             raise ValueError(
-                f"exec_c2r expects global shape {self.output_shape} (or padded "
-                f"{self.output_padded_shape}), got {tuple(c.shape)}")
+                f"inverse exec expects global shape {self.output_shape} (or "
+                f"padded {self.output_padded_shape}), got {tuple(c.shape)}")
         if not self.fft3d and tuple(c.shape) == self.output_shape \
                 and self.output_shape != self.output_padded_shape:
             c = self.pad_spectral(c)
-        return super().exec_c2r(c)
+        if self._c2r is None:
+            self._c2r = self._build_c2r()
+        return self._c2r(c)
 
     # -- pipeline bodies ---------------------------------------------------
     # Three reusable local bodies per direction. The fused builders compose
@@ -220,8 +260,13 @@ class SlabFFTPlan(DistFFTPlan):
         realigned = self.config.opt == 1
         split_pad, nx = self._split_pad, g.nx
 
+        complex_mode = self.transform == "c2c"
+
         def first(xl):
-            c = lf.rfft(xl, axis=s.r2c_axis, norm=norm)
+            if complex_mode:
+                c = lf.fft(xl, axis=s.r2c_axis, norm=norm)
+            else:
+                c = lf.rfft(xl, axis=s.r2c_axis, norm=norm)
             for a in s.pre_axes:
                 c = lf.fft(c, axis=a, norm=norm)
             return pad_axis_to(c, s.split_axis, split_pad)
@@ -244,6 +289,7 @@ class SlabFFTPlan(DistFFTPlan):
         realigned = self.config.opt == 1
         nx_pad, split_ext = self._nx_pad, self._split_ext
         real_n = g.nz if s.halved == "z" else g.ny
+        complex_mode = self.transform == "c2c"
 
         def first(cl):
             c = cl
@@ -261,6 +307,8 @@ class SlabFFTPlan(DistFFTPlan):
             c = slice_axis_to(cl, s.split_axis, split_ext)
             for a in reversed(s.pre_axes):
                 c = lf.ifft(c, axis=a, norm=norm)
+            if complex_mode:
+                return lf.ifft(c, axis=s.r2c_axis, norm=norm)
             return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm)
 
         return first, xpose, last
@@ -269,13 +317,15 @@ class SlabFFTPlan(DistFFTPlan):
 
     def _build_r2c(self):
         if self.fft3d:
-            return self._fft3d_r2c()
+            return (self._fft3d_c2c(forward=True) if self.transform == "c2c"
+                    else self._fft3d_r2c())
         return self._assemble(self._fwd_parts(), self._in_spec, self._out_spec,
                               self.config.comm_method)
 
     def _build_c2r(self):
         if self.fft3d:
-            return self._fft3d_c2r()
+            return (self._fft3d_c2c(forward=False) if self.transform == "c2c"
+                    else self._fft3d_c2r())
         return self._assemble(self._inv_parts(), self._out_spec, self._in_spec,
                               self.config.comm_method)
 
@@ -361,7 +411,7 @@ class SlabFFTPlan(DistFFTPlan):
         Always uses the explicit collective (timing needs a materialization
         boundary); the fused exec path is unaffected."""
         if self.fft3d:
-            return [(None, self.exec_r2c)]
+            return [(None, self._exec_fwd)]
         first, xpose, last = self._fwd_parts()
         d1, d2 = self._stage_descs()
         return self._jit_stages(
@@ -371,7 +421,7 @@ class SlabFFTPlan(DistFFTPlan):
 
     def inverse_stages(self):
         if self.fft3d:
-            return [(None, self.exec_c2r)]
+            return [(None, self._exec_inv)]
         first, xpose, last = self._inv_parts()
         d1, d2 = self._stage_descs()
         return self._jit_stages(

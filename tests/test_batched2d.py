@@ -1,0 +1,66 @@
+"""Batched distributed 2D FFT plan (BASELINE config #4 workload)."""
+
+import numpy as np
+import pytest
+
+from distributedfft_tpu import Config, SlabPartition
+from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+
+
+def ref2d(x):
+    return np.fft.fft(np.fft.rfft(x, axis=2), axis=1)
+
+
+@pytest.mark.parametrize("shard", ["batch", "x"])
+def test_forward_roundtrip(devices, rng, shard):
+    plan = Batched2DFFTPlan(16, 32, 32, SlabPartition(8), Config(),
+                            shard=shard)
+    x = rng.random((16, 32, 32))
+    c = plan.exec_forward(x)
+    np.testing.assert_allclose(plan.crop_spectral(c), ref2d(x), atol=1e-9)
+    r = plan.crop_real(plan.exec_inverse(c))
+    np.testing.assert_allclose(r, x * 32 * 32, atol=1e-8)
+
+
+def test_uneven_batch(devices, rng):
+    """batch=5 over 8 devices pads the batch axis."""
+    plan = Batched2DFFTPlan(5, 12, 10, SlabPartition(8), Config(),
+                            shard="batch")
+    assert plan.input_padded_shape == (8, 12, 10)
+    x = rng.random((5, 12, 10))
+    np.testing.assert_allclose(plan.crop_spectral(plan.exec_forward(x)),
+                               ref2d(x), atol=1e-9)
+
+
+def test_uneven_image_x_shard(devices, rng):
+    plan = Batched2DFFTPlan(3, 10, 9, SlabPartition(8), Config(), shard="x")
+    x = rng.random((3, 10, 9))
+    c = plan.exec_forward(x)
+    np.testing.assert_allclose(plan.crop_spectral(c), ref2d(x), atol=1e-9)
+    r = plan.crop_real(plan.exec_inverse(c))
+    np.testing.assert_allclose(r, x * 10 * 9, atol=1e-8)
+
+
+def test_c2c(devices, rng):
+    plan = Batched2DFFTPlan(4, 16, 16, SlabPartition(8), Config(),
+                            shard="x", transform="c2c")
+    xc = rng.random((4, 16, 16)) + 1j * rng.random((4, 16, 16))
+    np.testing.assert_allclose(plan.crop_spectral(plan.exec_forward(xc)),
+                               np.fft.fft2(xc), atol=1e-9)
+
+
+def test_single_device(rng):
+    plan = Batched2DFFTPlan(4, 16, 16, SlabPartition(1))
+    x = rng.random((4, 16, 16))
+    np.testing.assert_allclose(np.asarray(plan.exec_forward(x)), ref2d(x),
+                               atol=1e-9)
+
+
+def test_validation(devices):
+    with pytest.raises(ValueError, match="shard"):
+        Batched2DFFTPlan(4, 16, 16, SlabPartition(8), shard="y")
+    with pytest.raises(ValueError, match="positive"):
+        Batched2DFFTPlan(0, 16, 16, SlabPartition(8))
+    plan = Batched2DFFTPlan(4, 16, 16, SlabPartition(8))
+    with pytest.raises(ValueError, match="expected"):
+        plan.exec_forward(np.zeros((4, 8, 8)))

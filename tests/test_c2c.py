@@ -1,0 +1,106 @@
+"""C2C transform mode for slab and pencil engines (BASELINE configs #1/#2;
+an extension — the reference core is R2C/C2R-only, include/mpicufft.hpp)."""
+
+import numpy as np
+import pytest
+
+from distributedfft_tpu import (
+    Config,
+    GlobalSize,
+    PencilFFTPlan,
+    PencilPartition,
+    SlabFFTPlan,
+    SlabPartition,
+)
+
+
+@pytest.fixture()
+def xc(rng):
+    return rng.random((16, 16, 16)) + 1j * rng.random((16, 16, 16))
+
+
+@pytest.mark.parametrize("seq", ["ZY_Then_X", "Z_Then_YX", "Y_Then_ZX"])
+def test_slab_c2c(devices, xc, seq):
+    g = GlobalSize(16, 16, 16)
+    plan = SlabFFTPlan(g, SlabPartition(8), Config(), sequence=seq,
+                       transform="c2c")
+    assert plan.output_shape == g.shape  # no halved axis
+    c = plan.exec_c2c(xc)
+    np.testing.assert_allclose(plan.crop_spectral(c), np.fft.fftn(xc),
+                               atol=1e-10)
+    r = plan.crop_real(plan.exec_c2c_inv(c))
+    np.testing.assert_allclose(r, xc * g.n_total, atol=1e-8)
+
+
+@pytest.mark.parametrize("p1,p2", [(2, 4), (8, 1)])
+def test_pencil_c2c(devices, xc, p1, p2):
+    g = GlobalSize(16, 16, 16)
+    plan = PencilFFTPlan(g, PencilPartition(p1, p2), Config(),
+                         transform="c2c")
+    c = plan.exec_c2c(xc)
+    np.testing.assert_allclose(plan.crop_spectral(c), np.fft.fftn(xc),
+                               atol=1e-10)
+    r = plan.crop_real(plan.exec_c2c_inv(c))
+    np.testing.assert_allclose(r, xc * g.n_total, atol=1e-8)
+
+
+def test_pencil_c2c_partial_dims(devices, xc):
+    g = GlobalSize(16, 16, 16)
+    plan = PencilFFTPlan(g, PencilPartition(2, 4), Config(), transform="c2c")
+    c = plan.exec_c2c(xc, dims=2)
+    ref = np.fft.fft(np.fft.fft(xc, axis=2), axis=1)
+    np.testing.assert_allclose(plan.crop_spectral(c, 2), ref, atol=1e-10)
+
+
+def test_c2c_uneven(devices, rng):
+    g = GlobalSize(10, 6, 9)
+    xc = rng.random(g.shape) + 1j * rng.random(g.shape)
+    plan = SlabFFTPlan(g, SlabPartition(8), Config(), transform="c2c")
+    np.testing.assert_allclose(plan.crop_spectral(plan.exec_c2c(xc)),
+                               np.fft.fftn(xc), atol=1e-10)
+
+
+def test_mode_guards(devices, xc):
+    g = GlobalSize(16, 16, 16)
+    r2c = SlabFFTPlan(g, SlabPartition(8), Config())
+    c2c = SlabFFTPlan(g, SlabPartition(8), Config(), transform="c2c")
+    with pytest.raises(TypeError, match="transform='r2c'"):
+        r2c.exec_c2c(xc)
+    with pytest.raises(TypeError, match="transform='c2c'"):
+        c2c.exec_r2c(np.real(xc))
+    with pytest.raises(ValueError, match="transform"):
+        SlabFFTPlan(g, SlabPartition(8), Config(), transform="bogus")
+    p_r2c = PencilFFTPlan(g, PencilPartition(2, 4), Config())
+    with pytest.raises(TypeError, match="transform='r2c'"):
+        p_r2c.exec_c2c(xc)
+
+
+def test_staged_execution_c2c(devices, rng, xc):
+    """forward_stages/inverse_stages must work in c2c mode, including the
+    single-device fallback (regression: the fallback used to route through
+    the r2c-guarded exec methods)."""
+    g = GlobalSize(16, 16, 16)
+    for plan in (SlabFFTPlan(g, SlabPartition(8), Config(), transform="c2c"),
+                 SlabFFTPlan(g, SlabPartition(1), Config(), transform="c2c"),
+                 PencilFFTPlan(g, PencilPartition(1, 1), Config(),
+                               transform="c2c")):
+        y = xc
+        for _, fn in plan.forward_stages():
+            y = fn(y)
+        got = plan.crop_spectral(y) if plan.partition.num_ranks > 1 \
+            else np.asarray(y)
+        np.testing.assert_allclose(got, np.fft.fftn(xc), atol=1e-10)
+        for _, fn in plan.inverse_stages():
+            y = fn(y)
+
+
+def test_single_device_c2c(rng):
+    g = GlobalSize(12, 12, 12)
+    xc = rng.random(g.shape) + 1j * rng.random(g.shape)
+    plan = SlabFFTPlan(g, SlabPartition(1), transform="c2c")
+    np.testing.assert_allclose(np.asarray(plan.exec_c2c(xc)),
+                               np.fft.fftn(xc), atol=1e-10)
+    pplan = PencilFFTPlan(g, PencilPartition(1, 1), transform="c2c")
+    np.testing.assert_allclose(np.asarray(pplan.exec_c2c(xc, dims=2)),
+                               np.fft.fft(np.fft.fft(xc, axis=2), axis=1),
+                               atol=1e-10)

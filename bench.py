@@ -23,7 +23,7 @@ import sys
 import time
 
 N = 256
-K = 9
+K = 33
 BASELINE_ROUNDTRIP_MS = 4.4  # 2 x 2.20 ms (argon single-GPU 256^3 inverse, f64)
 DEADLINE_S = 480
 
@@ -36,6 +36,15 @@ def _deadline(sec):
 
 
 def roundtrip_chain(k: int, n: int):
+    """K roundtrips chained through a fori_loop, reduced to ONE scalar.
+
+    The scalar is read back with ``float()`` — measured on the axon tunnel,
+    ``jax.block_until_ready`` on an on-device array does NOT wait for an FFT
+    chain to finish (dispatch-only, ~0.07 ms for any K), while a scalar
+    readback is a true completion fence. The readback's own large constant
+    cost (~1.5 s through the tunnel) cancels in the (t_K - t_1)/(K - 1)
+    difference.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -46,7 +55,7 @@ def roundtrip_chain(k: int, n: int):
         # the chained value bounded so the loop cannot overflow.
         return jnp.fft.irfftn(c, s=v.shape, norm="forward") / float(n) ** 3
 
-    return jax.jit(lambda x: lax.fori_loop(0, k, body, x))
+    return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
 
 
 def main() -> int:
@@ -61,11 +70,11 @@ def main() -> int:
 
     def timed(k: int) -> float:
         fn = roundtrip_chain(k, N)
-        jax.block_until_ready(fn(x))  # compile + warm
+        float(fn(x))  # compile + warm (scalar readback = completion fence)
         best = float("inf")
-        for _ in range(3):
+        for _ in range(5):
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(x))
+            float(fn(x))
             best = min(best, time.perf_counter() - t0)
         return best
 
@@ -73,8 +82,9 @@ def main() -> int:
     tk = timed(K)
     per_iter_ms = (tk - t1) / (K - 1) * 1e3
     if per_iter_ms <= 0:
-        # Degenerate timing (async dispatch swallowed the work); fall back
-        # to the single-iteration wall time rather than reporting garbage.
+        # Degenerate timing (constant overheads swamped the difference);
+        # fall back to the single-iteration wall time rather than reporting
+        # garbage.
         per_iter_ms = t1 * 1e3
 
     print(json.dumps({

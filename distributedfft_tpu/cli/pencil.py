@@ -47,7 +47,8 @@ def main(argv=None) -> int:
                       if args.send_method2 else None),
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
-        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir)
+        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
+        fft_backend=args.fft_backend)
     plan = tc.make_plan("pencil", g,
                         pm.PencilPartition(args.partition1, args.partition2),
                         cfg)

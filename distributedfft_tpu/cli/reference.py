@@ -42,7 +42,8 @@ def main(argv=None) -> int:
     it, wu = args.iterations, args.warmup_rounds
 
     if args.testcase == 0:
-        ms = mb.single_device_fft_ms(shape, it, wu, dtype)
+        ms = mb.single_device_fft_ms(shape, it, wu, dtype,
+                                     backend=args.fft_backend)
         print(f"Run complete: {ms:.4f} ms (single-device 3D R2C, "
               f"{shape[0]}x{shape[1]}x{shape[2]})")
         return 0

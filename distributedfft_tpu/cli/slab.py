@@ -46,7 +46,8 @@ def main(argv=None) -> int:
         send_method=pm.SendMethod.parse(args.send_method),
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
-        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir)
+        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
+        fft_backend=args.fft_backend)
     plan = tc.make_plan("slab", g, pm.SlabPartition(p), cfg,
                         sequence=args.sequence)
     return run_testcase(plan, args)

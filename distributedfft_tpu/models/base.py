@@ -115,32 +115,32 @@ class DistFFTPlan:
     # -- single-device fallback ------------------------------------------
 
     def _fft3d_r2c(self):
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
 
         def run(x):
-            return local_fft.rfftn_3d(x, norm=norm)
+            return local_fft.rfftn_3d(x, norm=norm, backend=be)
 
         return jax.jit(run)
 
     def _fft3d_c2r(self):
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
         shape = self.input_shape
 
         def run(c):
-            return local_fft.irfftn_3d(c, shape, norm=norm)
+            return local_fft.irfftn_3d(c, shape, norm=norm, backend=be)
 
         return jax.jit(run)
 
     def _fft3d_c2c(self, forward: bool):
         """Single-device full 3D C2C (both directions unnormalized under
         FFTNorm.NONE, like cuFFT's CUFFT_FORWARD/CUFFT_INVERSE)."""
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
         axes = (-3, -2, -1)
 
         def run(c):
             if forward:
-                return local_fft.fftn(c, axes, norm=norm)
-            return local_fft.ifftn(c, axes, norm=norm)
+                return local_fft.fftn(c, axes, norm=norm, backend=be)
+            return local_fft.ifftn(c, axes, norm=norm, backend=be)
 
         return jax.jit(run)
 

@@ -21,7 +21,7 @@ Same padded-shape contract and comm-method mapping as the 3D engines.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -170,17 +170,17 @@ class Batched2DFFTPlan:
     # -- builders ----------------------------------------------------------
 
     def _fft2(self, x, forward: bool):
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
         if forward:
             if self.transform == "c2c":
-                c = lf.fft(x, axis=2, norm=norm)
+                c = lf.fft(x, axis=2, norm=norm, backend=be)
             else:
-                c = lf.rfft(x, axis=2, norm=norm)
-            return lf.fft(c, axis=1, norm=norm)
-        c = lf.ifft(x, axis=1, norm=norm)
+                c = lf.rfft(x, axis=2, norm=norm, backend=be)
+            return lf.fft(c, axis=1, norm=norm, backend=be)
+        c = lf.ifft(x, axis=1, norm=norm, backend=be)
         if self.transform == "c2c":
-            return lf.ifft(c, axis=2, norm=norm)
-        return lf.irfft(c, n=self.ny, axis=2, norm=norm)
+            return lf.ifft(c, axis=2, norm=norm, backend=be)
+        return lf.irfft(c, n=self.ny, axis=2, norm=norm, backend=be)
 
     def _build(self, forward: bool):
         if self.fft3d or self.shard == "batch":
@@ -197,7 +197,7 @@ class Batched2DFFTPlan:
     def _build_slab(self, forward: bool):
         """shard='x': 1D FFT y -> transpose (x-split -> y-split) -> 1D FFT x,
         the 2D restriction of the slab ZY_Then_X pipeline."""
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
         realigned = self.config.opt == 1
         nys_pad, nx_pad = self._nys_pad, self._nx_pad
         nx, ny, nys = self.nx, self.ny, self._ny_spec
@@ -206,25 +206,25 @@ class Batched2DFFTPlan:
         if forward:
             def body(xl):  # (B, nxb, ny)
                 if complex_mode:
-                    c = lf.fft(xl, axis=2, norm=norm)
+                    c = lf.fft(xl, axis=2, norm=norm, backend=be)
                 else:
-                    c = lf.rfft(xl, axis=2, norm=norm)
+                    c = lf.rfft(xl, axis=2, norm=norm, backend=be)
                 c = pad_axis_to(c, 2, nys_pad)
                 c = all_to_all_transpose(c, SLAB_AXIS, 2, 1,
                                          realigned=realigned)
                 c = slice_axis_to(c, 1, nx)
-                return lf.fft(c, axis=1, norm=norm)
+                return lf.fft(c, axis=1, norm=norm, backend=be)
             in_spec, out_spec = self._in_spec, self._out_spec
         else:
             def body(cl):  # (B, nx, nysb)
-                c = lf.ifft(cl, axis=1, norm=norm)
+                c = lf.ifft(cl, axis=1, norm=norm, backend=be)
                 c = pad_axis_to(c, 1, nx_pad)
                 c = all_to_all_transpose(c, SLAB_AXIS, 1, 2,
                                          realigned=realigned)
                 c = slice_axis_to(c, 2, nys)
                 if complex_mode:
-                    return lf.ifft(c, axis=2, norm=norm)
-                return lf.irfft(c, n=ny, axis=2, norm=norm)
+                    return lf.ifft(c, axis=2, norm=norm, backend=be)
+                return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be)
             in_spec, out_spec = self._out_spec, self._in_spec
         sm = jax.shard_map(body, mesh=self.mesh, in_specs=in_spec,
                            out_specs=out_spec)

@@ -270,15 +270,16 @@ class PencilFFTPlan(DistFFTPlan):
         pipeline stops before them."""
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
+        be = self.config.fft_backend
         nzc_p2, ny_p1 = self._nzc_p2, self._ny_p1
         ny, nx = g.ny, g.nx
         complex_mode = self.transform == "c2c"
 
         def s1(xl):
             if complex_mode:
-                c = lf.fft(xl, axis=2, norm=norm)
+                c = lf.fft(xl, axis=2, norm=norm, backend=be)
             else:
-                c = lf.rfft(xl, axis=2, norm=norm)
+                c = lf.rfft(xl, axis=2, norm=norm, backend=be)
             if dims >= 2:
                 c = pad_axis_to(c, 2, nzc_p2)
             return c
@@ -288,7 +289,7 @@ class PencilFFTPlan(DistFFTPlan):
 
         def s2(cl):
             c = slice_axis_to(cl, 1, ny)
-            c = lf.fft(c, axis=1, norm=norm)
+            c = lf.fft(c, axis=1, norm=norm, backend=be)
             if dims >= 3:
                 c = pad_axis_to(c, 1, ny_p1)
             return c
@@ -298,7 +299,7 @@ class PencilFFTPlan(DistFFTPlan):
 
         def s3(cl):
             c = slice_axis_to(cl, 0, nx)
-            return lf.fft(c, axis=0, norm=norm)
+            return lf.fft(c, axis=0, norm=norm, backend=be)
 
         return (s1, t1 if dims >= 2 else None, s2,
                 t2 if dims >= 3 else None, s3)
@@ -307,12 +308,13 @@ class PencilFFTPlan(DistFFTPlan):
         """(i3, t2b, i2, t1b, i1): inverse bodies mirroring ``_fwd_parts``."""
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
+        be = self.config.fft_backend
         nx_p1, ny_p2 = self._nx_p1, self._ny_p2
         ny, nzc, nz = g.ny, self._nz_spec, g.nz
         complex_mode = self.transform == "c2c"
 
         def i3(cl):
-            c = lf.ifft(cl, axis=0, norm=norm)
+            c = lf.ifft(cl, axis=0, norm=norm, backend=be)
             return pad_axis_to(c, 0, nx_p1)
 
         def t2b(cl):
@@ -320,7 +322,7 @@ class PencilFFTPlan(DistFFTPlan):
 
         def i2(cl):
             c = slice_axis_to(cl, 1, ny)
-            c = lf.ifft(c, axis=1, norm=norm)
+            c = lf.ifft(c, axis=1, norm=norm, backend=be)
             return pad_axis_to(c, 1, ny_p2)
 
         def t1b(cl):
@@ -329,8 +331,8 @@ class PencilFFTPlan(DistFFTPlan):
         def i1(cl):
             c = slice_axis_to(cl, 2, nzc)
             if complex_mode:
-                return lf.ifft(c, axis=2, norm=norm)
-            return lf.irfft(c, n=nz, axis=2, norm=norm)
+                return lf.ifft(c, axis=2, norm=norm, backend=be)
+            return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be)
 
         return (i3 if dims >= 3 else None, t2b if dims >= 3 else None,
                 i2 if dims >= 2 else None, t1b if dims >= 2 else None, i1)
@@ -485,35 +487,35 @@ class PencilFFTPlan(DistFFTPlan):
     # -- single-device partial-dim fallbacks ------------------------------
 
     def _fft3d_r2c_d(self, dims: int):
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
         complex_mode = self.transform == "c2c"
 
         def run(x):
             if complex_mode:
-                c = lf.fft(x, axis=2, norm=norm)
+                c = lf.fft(x, axis=2, norm=norm, backend=be)
             else:
-                c = lf.rfft(x, axis=2, norm=norm)
+                c = lf.rfft(x, axis=2, norm=norm, backend=be)
             if dims >= 2:
-                c = lf.fft(c, axis=1, norm=norm)
+                c = lf.fft(c, axis=1, norm=norm, backend=be)
             if dims >= 3:
-                c = lf.fft(c, axis=0, norm=norm)
+                c = lf.fft(c, axis=0, norm=norm, backend=be)
             return c
 
         return jax.jit(run)
 
     def _fft3d_c2r_d(self, dims: int):
-        norm = self.config.norm
+        norm, be = self.config.norm, self.config.fft_backend
         nz = self.global_size.nz
         complex_mode = self.transform == "c2c"
 
         def run(c):
             if dims >= 3:
-                c = lf.ifft(c, axis=0, norm=norm)
+                c = lf.ifft(c, axis=0, norm=norm, backend=be)
             if dims >= 2:
-                c = lf.ifft(c, axis=1, norm=norm)
+                c = lf.ifft(c, axis=1, norm=norm, backend=be)
             if complex_mode:
-                return lf.ifft(c, axis=2, norm=norm)
-            return lf.irfft(c, n=nz, axis=2, norm=norm)
+                return lf.ifft(c, axis=2, norm=norm, backend=be)
+            return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be)
 
         return jax.jit(run)
 

@@ -8,6 +8,11 @@ maps the cuFFT "unnormalized both ways" convention onto numpy norm strings.
 
 All functions are shape-polymorphic, jit-safe wrappers; batching comes from
 the untouched axes (cuFFT "batched plan" ≙ XLA treating other axes as batch).
+
+Every entry point takes ``backend``: ``"xla"`` (default) lowers to XLA's FFT
+expansion; ``"matmul"`` dispatches to the MXU matmul four-step backend
+(``ops/mxu_fft.py``) — the TPU-first alternative that keeps the FLOPs on the
+systolic array. Selected plan-wide via ``Config.fft_backend``.
 """
 
 from __future__ import annotations
@@ -17,6 +22,24 @@ from typing import Sequence, Tuple
 import jax.numpy as jnp
 
 from ..params import FFTNorm
+
+BACKENDS = ("xla", "matmul")
+
+
+def _mxu():
+    from . import mxu_fft
+    return mxu_fft
+
+
+def validate_backend(backend: str) -> str:
+    if backend not in BACKENDS:
+        raise ValueError(f"unknown fft backend {backend!r}; expected one of "
+                         f"{BACKENDS}")
+    return backend
+
+
+def _use_matmul(backend: str) -> bool:
+    return validate_backend(backend) == "matmul"
 
 
 def dtypes_for(double_prec: bool) -> Tuple[jnp.dtype, jnp.dtype]:
@@ -42,42 +65,62 @@ def _inv_norm(norm: FFTNorm) -> str:
     return "backward"
 
 
-def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Forward R2C along one axis (cuFFT ``execR2C`` analog, 1D case)."""
+    if _use_matmul(backend):
+        return _mxu().rfft(x, axis=axis, norm=norm)
     return jnp.fft.rfft(x, axis=axis, norm=_fwd_norm(norm))
 
 
-def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE):
+def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE,
+          backend: str = "xla"):
     """Inverse C2R along one axis; ``n`` is the real output extent (needed
     because the halved axis length ``n//2+1`` is ambiguous)."""
+    if _use_matmul(backend):
+        return _mxu().irfft(x, n=n, axis=axis, norm=norm)
     return jnp.fft.irfft(x, n=n, axis=axis, norm=_inv_norm(norm))
 
 
-def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Forward C2C along one axis (cuFFT ``execC2C(..., CUFFT_FORWARD)``)."""
+    if _use_matmul(backend):
+        return _mxu().fft(x, axis=axis, norm=norm)
     return jnp.fft.fft(x, axis=axis, norm=_fwd_norm(norm))
 
 
-def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Inverse C2C along one axis (cuFFT ``execC2C(..., CUFFT_INVERSE)``)."""
+    if _use_matmul(backend):
+        return _mxu().ifft(x, axis=axis, norm=norm)
     return jnp.fft.ifft(x, axis=axis, norm=_inv_norm(norm))
 
 
-def fftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE):
+def fftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE,
+         backend: str = "xla"):
+    if _use_matmul(backend):
+        return _mxu().fftn(x, axes=axes, norm=norm)
     return jnp.fft.fftn(x, axes=tuple(axes), norm=_fwd_norm(norm))
 
 
-def ifftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE):
+def ifftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE,
+          backend: str = "xla"):
+    if _use_matmul(backend):
+        return _mxu().ifftn(x, axes=axes, norm=norm)
     return jnp.fft.ifftn(x, axes=tuple(axes), norm=_inv_norm(norm))
 
 
-def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE):
+def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Single-device full 3D R2C over the trailing three axes — the analog of
     the reference's ``cufftMakePlan3d`` single-process fallback
     (``src/mpicufft.cpp:65``, ``src/slab/default/mpicufft_slab.cpp:142-145``).
     The halved axis is z (the last), matching cuFFT's layout."""
+    if _use_matmul(backend):
+        return _mxu().rfftn_3d(x, norm=norm)
     return jnp.fft.rfftn(x, axes=(-3, -2, -1), norm=_fwd_norm(norm))
 
 
-def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE):
+def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE,
+              backend: str = "xla"):
+    if _use_matmul(backend):
+        return _mxu().irfftn_3d(x, shape_3d=shape_3d, norm=norm)
     return jnp.fft.irfftn(x, s=shape_3d, axes=(-3, -2, -1), norm=_inv_norm(norm))

@@ -253,6 +253,10 @@ class Config:
     opt only changes benchmark labeling and the internal einsum order.
     ``cuda_aware`` is accepted for CLI compatibility; device-resident
     collectives are always on for TPU.
+    ``fft_backend`` selects the local-transform implementation: ``"xla"``
+    (XLA's FFT expansion) or ``"matmul"`` (MXU four-step DFT matmuls,
+    ``ops/mxu_fft.py``) — the TPU analog of the reference's cuFFT-plan
+    choice at L0 (``include/cufft.hpp:23-61``).
     """
 
     comm_method: CommMethod = CommMethod.ALL2ALL
@@ -266,6 +270,11 @@ class Config:
     double_prec: bool = False
     norm: FFTNorm = FFTNorm.NONE
     benchmark_dir: str = "benchmarks"
+    fft_backend: str = "xla"
+
+    def __post_init__(self):
+        from .ops.fft import validate_backend  # lazy: ops.fft imports params
+        validate_backend(self.fft_backend)
 
     def resolved_comm2(self) -> CommMethod:
         return self.comm_method2 if self.comm_method2 is not None else self.comm_method

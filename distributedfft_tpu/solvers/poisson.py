@@ -34,7 +34,6 @@ import jax
 import jax.numpy as jnp
 
 from .. import params as pm
-from ..models.pencil import PencilFFTPlan
 from ..models.slab import SlabFFTPlan
 
 
@@ -83,23 +82,23 @@ class PoissonSolver:
         shape = plan.output_padded_shape
         halved_axis = self._halved_axis()
         dims = [g.nx, g.ny, g.nz]
+        rt, _ = _plan_dtypes(plan)
         ks = []
         for ax in range(3):
             k = _axis_freqs(dims[ax], shape[ax], ax == halved_axis,
                             mode == "integer")
             if mode == "physical":
                 k = k * (2 * np.pi / self.lengths[ax])
-            ks.append(k)
-        k1, k2, k3 = np.meshgrid(*ks, indexing="ij")
-        k2sum = k1 ** 2 + k2 ** 2 + k3 ** 2
-        with np.errstate(divide="ignore"):
-            inv = np.where(k2sum > 0, -1.0 / np.where(k2sum > 0, k2sum, 1.0), 0.0)
+            ks.append(k.astype(rt))
+        # Only the three 1D wavenumber vectors are stored; the dense symbol
+        # is formed by broadcasting inside the jitted apply, so each device
+        # materializes (at most) its own shard — at the module's 2048^3
+        # target a host-side dense cube would be tens of GB.
+        self._ks = ks
         # Fold the round-trip normalization into the symbol so the solve is
         # exactly: inverse(forward(f) * symbol).
-        if plan.config.norm is pm.FFTNorm.NONE:
-            inv = inv / g.n_total
-        _, cdt = _plan_dtypes(plan)
-        self._symbol_host = inv.astype(cdt)
+        self._scale = (1.0 / g.n_total
+                       if plan.config.norm is pm.FFTNorm.NONE else 1.0)
         self._apply = None
 
     def _halved_axis(self) -> int:
@@ -112,13 +111,20 @@ class PoissonSolver:
 
     def _build_apply(self):
         plan = self.plan
-        sym = jnp.asarray(self._symbol_host)
+        k1, k2, k3 = (jnp.asarray(k) for k in self._ks)
+        scale = self._scale
+
+        def apply(c):
+            k2sum = (k1[:, None, None] ** 2 + k2[None, :, None] ** 2
+                     + k3[None, None, :] ** 2)
+            inv = jnp.where(k2sum > 0,
+                            -scale / jnp.where(k2sum > 0, k2sum, 1.0), 0.0)
+            return c * inv.astype(c.real.dtype)
+
         if plan.mesh is not None:
             ns = plan.output_sharding
-            sym = jax.device_put(sym, ns)
-            return jax.jit(lambda c: c * sym, in_shardings=ns,
-                           out_shardings=ns)
-        return jax.jit(lambda c: c * sym)
+            return jax.jit(apply, in_shardings=ns, out_shardings=ns)
+        return jax.jit(apply)
 
     def solve(self, f):
         """u with ∇²u = f (periodic, zero-mean). Accepts logical or padded

@@ -81,20 +81,32 @@ def main() -> int:
     t1 = timed(1)
     tk = timed(K)
     per_iter_ms = (tk - t1) / (K - 1) * 1e3
-    if per_iter_ms <= 0:
-        # Degenerate timing (constant overheads swamped the difference);
-        # fall back to the single-iteration wall time rather than reporting
-        # garbage.
-        per_iter_ms = t1 * 1e3
+    degenerate = per_iter_ms <= 0
+    if degenerate:
+        # Constant overheads swamped the K-vs-1 difference. t1 includes the
+        # ~1.5 s scalar-readback constant, so subtract a measured null
+        # readback (same fence, no FFT work) before falling back to it.
+        import jax.numpy as jnp
+        null_fn = jax.jit(lambda v: jnp.sum(v))
+        float(null_fn(x))
+        t0 = float("inf")
+        for _ in range(5):
+            s = time.perf_counter()
+            float(null_fn(x))
+            t0 = min(t0, time.perf_counter() - s)
+        per_iter_ms = max((t1 - t0) * 1e3, 1e-3)
 
-    print(json.dumps({
+    result = {
         "metric": f"single-chip 256^3 f32 R2C+C2R roundtrip ms on {platform} "
                   f"(vs argon single-GPU f64 cufftPlan3d {BASELINE_ROUNDTRIP_MS} ms; "
                   f"vs_baseline = baseline/ours, >1 is faster)",
         "value": round(per_iter_ms, 4),
         "unit": "ms",
         "vs_baseline": round(BASELINE_ROUNDTRIP_MS / per_iter_ms, 3),
-    }))
+    }
+    if degenerate:
+        result["degenerate"] = True
+    print(json.dumps(result))
     signal.alarm(0)
     return 0
 

@@ -16,6 +16,8 @@ from __future__ import annotations
 import argparse
 import os
 
+from ..ops.fft import BACKENDS
+
 
 def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
     ap.add_argument("--input-dim-x", "-nx", type=int, required=True,
@@ -43,10 +45,11 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
                          "no native f64)")
     ap.add_argument("--benchmark_dir", "-b", default="benchmarks",
                     help="prefix for the benchmark directory")
-    ap.add_argument("--fft-backend", default="xla", choices=("xla", "matmul"),
+    ap.add_argument("--fft-backend", default="xla", choices=BACKENDS,
                     help="local transform implementation: XLA's FFT "
-                         "expansion (default) or MXU four-step DFT matmuls "
-                         "(ops/mxu_fft.py)")
+                         "expansion (default), MXU four-step DFT matmuls "
+                         "(ops/mxu_fft.py), or Pallas fused DFT+twiddle "
+                         "kernels (ops/pallas_fft.py)")
     ap.add_argument("--emulate-devices", type=int,
                     default=int(os.environ.get("DFFT_EMULATE_DEVICES", "0")),
                     help="force N virtual CPU devices (0 = use real backend)")

@@ -12,7 +12,9 @@ the untouched axes (cuFFT "batched plan" ≙ XLA treating other axes as batch).
 Every entry point takes ``backend``: ``"xla"`` (default) lowers to XLA's FFT
 expansion; ``"matmul"`` dispatches to the MXU matmul four-step backend
 (``ops/mxu_fft.py``) — the TPU-first alternative that keeps the FLOPs on the
-systolic array. Selected plan-wide via ``Config.fft_backend``.
+systolic array; ``"pallas"`` runs the same four-step with hand-written
+Pallas kernels fusing the twiddle epilogue into the DFT matmul
+(``ops/pallas_fft.py``). Selected plan-wide via ``Config.fft_backend``.
 """
 
 from __future__ import annotations
@@ -23,12 +25,17 @@ import jax.numpy as jnp
 
 from ..params import FFTNorm
 
-BACKENDS = ("xla", "matmul")
+BACKENDS = ("xla", "matmul", "pallas")
 
 
 def _mxu():
     from . import mxu_fft
     return mxu_fft
+
+
+def _pallas():
+    from . import pallas_fft
+    return pallas_fft
 
 
 def validate_backend(backend: str) -> str:
@@ -38,8 +45,14 @@ def validate_backend(backend: str) -> str:
     return backend
 
 
-def _use_matmul(backend: str) -> bool:
-    return validate_backend(backend) == "matmul"
+def _impl(backend: str):
+    """Non-XLA implementation module for ``backend``, or None for "xla"."""
+    b = validate_backend(backend)
+    if b == "matmul":
+        return _mxu()
+    if b == "pallas":
+        return _pallas()
+    return None
 
 
 def dtypes_for(double_prec: bool) -> Tuple[jnp.dtype, jnp.dtype]:
@@ -67,8 +80,9 @@ def _inv_norm(norm: FFTNorm) -> str:
 
 def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Forward R2C along one axis (cuFFT ``execR2C`` analog, 1D case)."""
-    if _use_matmul(backend):
-        return _mxu().rfft(x, axis=axis, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.rfft(x, axis=axis, norm=norm)
     return jnp.fft.rfft(x, axis=axis, norm=_fwd_norm(norm))
 
 
@@ -76,36 +90,41 @@ def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE,
           backend: str = "xla"):
     """Inverse C2R along one axis; ``n`` is the real output extent (needed
     because the halved axis length ``n//2+1`` is ambiguous)."""
-    if _use_matmul(backend):
-        return _mxu().irfft(x, n=n, axis=axis, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.irfft(x, n=n, axis=axis, norm=norm)
     return jnp.fft.irfft(x, n=n, axis=axis, norm=_inv_norm(norm))
 
 
 def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Forward C2C along one axis (cuFFT ``execC2C(..., CUFFT_FORWARD)``)."""
-    if _use_matmul(backend):
-        return _mxu().fft(x, axis=axis, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.fft(x, axis=axis, norm=norm)
     return jnp.fft.fft(x, axis=axis, norm=_fwd_norm(norm))
 
 
 def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     """Inverse C2C along one axis (cuFFT ``execC2C(..., CUFFT_INVERSE)``)."""
-    if _use_matmul(backend):
-        return _mxu().ifft(x, axis=axis, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.ifft(x, axis=axis, norm=norm)
     return jnp.fft.ifft(x, axis=axis, norm=_inv_norm(norm))
 
 
 def fftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE,
          backend: str = "xla"):
-    if _use_matmul(backend):
-        return _mxu().fftn(x, axes=axes, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.fftn(x, axes=axes, norm=norm)
     return jnp.fft.fftn(x, axes=tuple(axes), norm=_fwd_norm(norm))
 
 
 def ifftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE,
           backend: str = "xla"):
-    if _use_matmul(backend):
-        return _mxu().ifftn(x, axes=axes, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.ifftn(x, axes=axes, norm=norm)
     return jnp.fft.ifftn(x, axes=tuple(axes), norm=_inv_norm(norm))
 
 
@@ -114,13 +133,15 @@ def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
     the reference's ``cufftMakePlan3d`` single-process fallback
     (``src/mpicufft.cpp:65``, ``src/slab/default/mpicufft_slab.cpp:142-145``).
     The halved axis is z (the last), matching cuFFT's layout."""
-    if _use_matmul(backend):
-        return _mxu().rfftn_3d(x, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.rfftn_3d(x, norm=norm)
     return jnp.fft.rfftn(x, axes=(-3, -2, -1), norm=_fwd_norm(norm))
 
 
 def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE,
               backend: str = "xla"):
-    if _use_matmul(backend):
-        return _mxu().irfftn_3d(x, shape_3d=shape_3d, norm=norm)
+    m = _impl(backend)
+    if m is not None:
+        return m.irfftn_3d(x, shape_3d=shape_3d, norm=norm)
     return jnp.fft.irfftn(x, s=shape_3d, axes=(-3, -2, -1), norm=_inv_norm(norm))

@@ -1,0 +1,356 @@
+"""Pallas TPU kernel FFT backend (fused DFT-matmul + twiddle epilogue).
+
+The ``"matmul"`` backend (``ops/mxu_fft.py``) expresses each four-step DFT
+stage as XLA ``dot_general`` calls plus a separate elementwise twiddle
+multiply, trusting the compiler to fuse and schedule them. This backend makes
+that hot op a hand-written Pallas kernel instead:
+
+* one kernel = one four-step stage: the complex matmul (four real MXU
+  matmuls) **and** the twiddle multiply run in a single VMEM-resident pass,
+  so intermediate stage output never round-trips to HBM between the matmul
+  and the twiddle (the analog of the reference baking the transpose into the
+  cuFFT plan's striding, ``include/mpicufft_slab_opt1.hpp:46-54`` — move work
+  into the producer instead of a separate pass);
+* a real-input variant halves the MXU work for the R2C first stage (two real
+  matmuls instead of four);
+* the grid tiles the flattened batch rows; DFT/twiddle constants are a
+  single VMEM block reused by every grid step.
+
+Row-twiddle contract: for a stage input reshaped to ``(..., n1, n2)`` the
+flattened matmul row index is ``b*n1 + r``, so the twiddle row is
+``row % n1`` — the kernel receives the twiddle pre-tiled to the row-block
+height (a multiple of ``n1``), keeping the epilogue a plain elementwise
+multiply with no gather.
+
+Selected via ``Config.fft_backend = "pallas"``. Off-TPU (the CPU test mesh)
+the kernels run in Pallas interpret mode; f64 inputs fall back to the
+``matmul`` backend's jnp path on TPU (no native f64 there — correctness
+gates for double precision run on CPU, SURVEY §7 hard parts).
+
+Public API mirrors ``ops/mxu_fft.py`` (same signatures, same FFTNorm
+semantics); the four-step recursion and constant caches are shared with it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on builds without TPU support compiled in
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..params import FFTNorm
+from . import mxu_fft as mx
+
+# Row-block height per grid step (padded up to a multiple of the twiddle
+# period n1 when a twiddle is fused). 256 f32 rows x <=512 lanes keeps
+# x/y/F/T blocks ~4.5 MB total, comfortably inside ~16 MB VMEM.
+_ROW_BLOCK = 256
+
+# Largest contraction length the kernel accepts with the full DFT matrix
+# resident in VMEM. Above this (huge prime axis lengths), fall back to the
+# jnp matmul path.
+_N_MAX = 1024
+
+_PREC = lax.Precision.HIGHEST
+
+
+def _interpret() -> bool:
+    """Compile on TPU; interpret elsewhere (the CPU test mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def available() -> bool:
+    return _HAS_PLTPU
+
+
+# ---------------------------------------------------------------------------
+# Kernels. Complex arrays travel as (real, imag) f32 pairs: Mosaic has no
+# native complex tiles, and split planes let each product hit the MXU as a
+# plain f32 matmul.
+# ---------------------------------------------------------------------------
+
+
+def _dot(a, b):
+    return jnp.dot(a, b, precision=_PREC, preferred_element_type=jnp.float32)
+
+
+def _cmatmul_kernel(xr_ref, xi_ref, fr_ref, fi_ref, yr_ref, yi_ref):
+    xr, xi = xr_ref[:], xi_ref[:]
+    fr, fi = fr_ref[:], fi_ref[:]
+    yr_ref[:] = _dot(xr, fr) - _dot(xi, fi)
+    yi_ref[:] = _dot(xr, fi) + _dot(xi, fr)
+
+
+def _cmatmul_tw_kernel(xr_ref, xi_ref, fr_ref, fi_ref, tr_ref, ti_ref,
+                       yr_ref, yi_ref):
+    xr, xi = xr_ref[:], xi_ref[:]
+    fr, fi = fr_ref[:], fi_ref[:]
+    yr = _dot(xr, fr) - _dot(xi, fi)
+    yi = _dot(xr, fi) + _dot(xi, fr)
+    tr, ti = tr_ref[:], ti_ref[:]
+    yr_ref[:] = yr * tr - yi * ti      # twiddle epilogue, fused in VMEM
+    yi_ref[:] = yr * ti + yi * tr
+
+
+def _rmatmul_kernel(x_ref, fr_ref, fi_ref, yr_ref, yi_ref):
+    x = x_ref[:]
+    yr_ref[:] = _dot(x, fr_ref[:])
+    yi_ref[:] = _dot(x, fi_ref[:])
+
+
+def _rmatmul_tw_kernel(x_ref, fr_ref, fi_ref, tr_ref, ti_ref,
+                       yr_ref, yi_ref):
+    x = x_ref[:]
+    yr = _dot(x, fr_ref[:])
+    yi = _dot(x, fi_ref[:])
+    tr, ti = tr_ref[:], ti_ref[:]
+    yr_ref[:] = yr * tr - yi * ti
+    yi_ref[:] = yr * ti + yi * tr
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+
+def _row_block(period: int) -> int:
+    """Row-block height: a multiple of the twiddle period covering >= 256
+    rows when possible (period 1 = no twiddle alignment constraint)."""
+    if period >= _ROW_BLOCK:
+        return period
+    return period * (_ROW_BLOCK // period)
+
+
+@functools.lru_cache(maxsize=None)
+def _tiled_twiddle(n1: int, n2: int, inverse: bool, tb: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Four-step twiddle tiled up to the row-block height (f32 planes)."""
+    t = mx._twiddle_np(n1, n2, inverse, False)
+    t = np.tile(t, (tb // n1, 1))
+    return (np.ascontiguousarray(t.real.astype(np.float32)),
+            np.ascontiguousarray(t.imag.astype(np.float32)))
+
+
+def _f32_planes(F: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    return (np.ascontiguousarray(F.real.astype(np.float32)),
+            np.ascontiguousarray(F.imag.astype(np.float32)))
+
+
+def _call_stage(x2, F_np: np.ndarray, twiddle: "Tuple[int, int, bool] | None"):
+    """One DFT stage on 2D data: ``y = (x2 @ F) [* T]``.
+
+    x2: (M, n) complex64 or float32 (real-input fast path); F_np: (n, k);
+    twiddle: (n1, n2, inverse) with rows of x2 cycling through n1.
+    Returns (M, k) complex64.
+    """
+    m, n = x2.shape
+    k = F_np.shape[1]
+    real_in = not jnp.issubdtype(x2.dtype, jnp.complexfloating)
+
+    if _interpret() and getattr(jax.typeof(x2), "vma", frozenset()):
+        # Pallas's HLO interpreter cannot yet thread shard_map's vma through
+        # its internal grid loop carries; off-TPU, inside shard_map, compute
+        # the stage with the equivalent jnp ops (the compiled Mosaic path on
+        # real TPU takes the kernel below).
+        F = jnp.asarray(F_np.astype(np.complex64))
+        y = (mx._rmatmul_F(x2.astype(jnp.float32), F_np.astype(np.complex64))
+             if real_in else jnp.matmul(x2.astype(jnp.complex64), F,
+                                        precision=_PREC))
+        if twiddle is not None:
+            n1, n2, inv = twiddle
+            tr, ti = _tiled_twiddle(n1, n2, inv, _row_block(n1))
+            t = lax.complex(jnp.asarray(tr), jnp.asarray(ti))
+            reps = (m + t.shape[0] - 1) // t.shape[0]
+            y = y * jnp.tile(t, (reps, 1))[:m]
+        return y
+
+    period = twiddle[0] if twiddle is not None else 1
+    tb = _row_block(period)
+    m_pad = tb * ((m + tb - 1) // tb)
+    if m_pad != m:
+        x2 = jnp.pad(x2, [(0, m_pad - m), (0, 0)])
+    grid = (m_pad // tb,)
+
+    fr, fi = _f32_planes(F_np)
+    row_spec = pl.BlockSpec((tb, n), lambda i: (i, 0))
+    const_spec = pl.BlockSpec((n, k), lambda i: (0, 0))
+    tw_spec = pl.BlockSpec((tb, k), lambda i: (0, 0))
+    out_spec = pl.BlockSpec((tb, k), lambda i: (i, 0))
+    # Propagate the input's varying-across-mesh-axes set so the kernel works
+    # under shard_map's vma checking (per-shard data varies over the mesh).
+    vma = getattr(jax.typeof(x2), "vma", frozenset())
+    out_shape = [jax.ShapeDtypeStruct((m_pad, k), jnp.float32, vma=vma)] * 2
+
+    flops_c = (2 if real_in else 4) * 2 * m_pad * n * k
+    cost = pl.CostEstimate(flops=flops_c, transcendentals=0,
+                           bytes_accessed=4 * (m_pad * (n + k) * 2 + n * k * 2))
+
+    if real_in:
+        args = [x2.astype(jnp.float32), fr, fi]
+        specs = [row_spec, const_spec, const_spec]
+        kern = _rmatmul_kernel if twiddle is None else _rmatmul_tw_kernel
+    else:
+        xc = x2.astype(jnp.complex64)
+        args = [jnp.real(xc), jnp.imag(xc), fr, fi]
+        specs = [row_spec, row_spec, const_spec, const_spec]
+        kern = _cmatmul_kernel if twiddle is None else _cmatmul_tw_kernel
+    if twiddle is not None:
+        n1, n2, inv = twiddle
+        tr, ti = _tiled_twiddle(n1, n2, inv, tb)
+        args += [jnp.asarray(tr), jnp.asarray(ti)]
+        specs += [tw_spec, tw_spec]
+    if vma:
+        # Under shard_map every operand of the kernel must carry the same
+        # varying-axes set; lift the replicated constants to match the data.
+        def _lift(a):
+            missing = vma - getattr(jax.typeof(a), "vma", frozenset())
+            return lax.pvary(a, tuple(missing)) if missing else a
+        args = [_lift(a) for a in args]
+
+    yr, yi = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=specs,
+        out_specs=[out_spec, out_spec],
+        out_shape=out_shape,
+        cost_estimate=cost,
+        interpret=_interpret(),
+    )(*args)
+    y = lax.complex(yr, yi)
+    return y[:m] if m_pad != m else y
+
+
+def _stage(x, F_np: np.ndarray, twiddle=None):
+    """DFT stage along the LAST axis of an nd array (rows = flattened rest)."""
+    lead = x.shape[:-1]
+    y2 = _call_stage(x.reshape((-1, x.shape[-1])), F_np, twiddle)
+    return y2.reshape(lead + (F_np.shape[1],))
+
+
+def _use_fallback(x) -> bool:
+    """jnp-matmul fallback: no pltpu build, f64 data (kernel is f32-only;
+    f64 gates run via the matmul backend on CPU), or oversized axis."""
+    return (not _HAS_PLTPU) or mx._is_double(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Four-step recursion (structure shared with mxu_fft, stages fused here)
+# ---------------------------------------------------------------------------
+
+
+def _fft_last(x, inverse: bool):
+    n = x.shape[-1]
+    if _use_fallback(x):
+        return mx._fft_last(x, inverse)
+    if n <= mx.DIRECT_MAX:
+        return _stage(x, mx._dft_np(n, inverse, False))
+    n1, n2 = mx._split(n)
+    if n1 == 1:  # prime length
+        if n <= _N_MAX:
+            return _stage(x, mx._dft_np(n, inverse, False))
+        return mx._fft_last(x, inverse)
+    a = jnp.swapaxes(x.reshape(x.shape[:-1] + (n2, n1)), -1, -2)  # (.., n1, n2)
+    if n2 <= mx.DIRECT_MAX:
+        # Fused: DFT over s and the twiddle epilogue in one kernel pass.
+        c = _stage(a, mx._dft_np(n2, inverse, False), twiddle=(n1, n2, inverse))
+    else:
+        c = _fft_last(a, inverse) * jnp.asarray(
+            mx._twiddle_np(n1, n2, inverse, False))
+    d = _fft_last(jnp.swapaxes(c, -1, -2), inverse)
+    return jnp.swapaxes(d, -1, -2).reshape(x.shape[:-1] + (n,))
+
+
+def _rfft_last(x):
+    n = x.shape[-1]
+    n_out = n // 2 + 1
+    if _use_fallback(x):
+        return mx._rfft_last(x)
+    if n <= mx.DIRECT_MAX:
+        return _stage(x, mx._dft_np(n, False, False)[:, :n_out])
+    n1, n2 = mx._split(n)
+    if n1 == 1:
+        if n <= _N_MAX:
+            return _stage(x, mx._dft_np(n, False, False)[:, :n_out])
+        return mx._rfft_last(x)
+    a = jnp.swapaxes(x.reshape(x.shape[:-1] + (n2, n1)), -1, -2)
+    if n2 <= mx.DIRECT_MAX:
+        # Real-input fused stage: two MXU matmuls + twiddle epilogue.
+        c = _stage(a, mx._dft_np(n2, False, False), twiddle=(n1, n2, False))
+    else:
+        c = _fft_last(a.astype(jnp.complex64), False) * jnp.asarray(
+            mx._twiddle_np(n1, n2, False, False))
+    d = _fft_last(jnp.swapaxes(c, -1, -2), False)
+    full = jnp.swapaxes(d, -1, -2).reshape(x.shape[:-1] + (n,))
+    return full[..., :n_out]
+
+
+# ---------------------------------------------------------------------------
+# Public API (mirrors ops/mxu_fft.py; same FFTNorm semantics)
+# ---------------------------------------------------------------------------
+
+
+def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    x = jnp.moveaxis(x, axis, -1)
+    if not mx._is_double(x.dtype):
+        x = x.astype(jnp.complex64)
+    y = mx._scaled(_fft_last(x, False), mx._fwd_scale(x.shape[-1], norm))
+    return jnp.moveaxis(y, -1, axis)
+
+
+def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    x = jnp.moveaxis(x, axis, -1)
+    if not mx._is_double(x.dtype):
+        x = x.astype(jnp.complex64)
+    y = mx._scaled(_fft_last(x, True), mx._inv_scale(x.shape[-1], norm))
+    return jnp.moveaxis(y, -1, axis)
+
+
+def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    x = jnp.moveaxis(x, axis, -1)
+    y = mx._scaled(_rfft_last(x), mx._fwd_scale(x.shape[-1], norm))
+    return jnp.moveaxis(y, -1, axis)
+
+
+def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE):
+    c = jnp.moveaxis(x, axis, -1)
+    if not mx._is_double(c.dtype):
+        c = c.astype(jnp.complex64)
+    c = mx._fit_axis(c, -1, n // 2 + 1)
+    full = mx._hermitian_extend(c, n)
+    y = jnp.real(_fft_last(full, True))
+    return jnp.moveaxis(mx._scaled(y, mx._inv_scale(n, norm)), -1, axis)
+
+
+def fftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE):
+    for a in axes:
+        x = fft(x, axis=a, norm=norm)
+    return x
+
+
+def ifftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE):
+    for a in axes:
+        x = ifft(x, axis=a, norm=norm)
+    return x
+
+
+def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE):
+    c = rfft(x, axis=-1, norm=norm)
+    c = fft(c, axis=-2, norm=norm)
+    return fft(c, axis=-3, norm=norm)
+
+
+def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE):
+    c = ifft(mx._fit_axis(x, -3, shape_3d[-3]), axis=-3, norm=norm)
+    c = ifft(mx._fit_axis(c, -2, shape_3d[-2]), axis=-2, norm=norm)
+    return irfft(c, n=shape_3d[-1], axis=-1, norm=norm)

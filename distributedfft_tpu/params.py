@@ -254,9 +254,11 @@ class Config:
     ``cuda_aware`` is accepted for CLI compatibility; device-resident
     collectives are always on for TPU.
     ``fft_backend`` selects the local-transform implementation: ``"xla"``
-    (XLA's FFT expansion) or ``"matmul"`` (MXU four-step DFT matmuls,
-    ``ops/mxu_fft.py``) — the TPU analog of the reference's cuFFT-plan
-    choice at L0 (``include/cufft.hpp:23-61``).
+    (XLA's FFT expansion), ``"matmul"`` (MXU four-step DFT matmuls,
+    ``ops/mxu_fft.py``), or ``"pallas"`` (Pallas kernels fusing the
+    four-step twiddle into the DFT matmul, ``ops/pallas_fft.py``) — the TPU
+    analog of the reference's cuFFT-plan choice at L0
+    (``include/cufft.hpp:23-61``).
     """
 
     comm_method: CommMethod = CommMethod.ALL2ALL

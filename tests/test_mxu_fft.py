@@ -62,6 +62,20 @@ def test_axis_and_ortho(rng):
     assert _rel(got2, np.fft.ifft(c, axis=0, norm="ortho")) < 1e-11
 
 
+def test_four_step_recursion(rng):
+    """n=1042 splits to (2, 521) with 521 > DIRECT_MAX, forcing _fft_last to
+    recurse (prime inner stage) and _rfft_last through its complex-promotion
+    branch — the recursion paths no NS size reaches."""
+    n = 1042
+    assert mxu_fft._split(n) == (2, 521) and 521 > mxu_fft.DIRECT_MAX
+    x = rng.standard_normal((2, n)).astype(np.float64)
+    got = np.asarray(mxu_fft.rfft(x, axis=-1))
+    assert _rel(got, np.fft.rfft(x, axis=-1)) < 1e-10
+    c = (rng.standard_normal((2, n)) + 1j * rng.standard_normal((2, n)))
+    gotc = np.asarray(mxu_fft.fft(c, axis=-1))
+    assert _rel(gotc, np.fft.fft(c, axis=-1)) < 1e-10
+
+
 def test_split_balanced():
     assert mxu_fft._split(1024) == (32, 32)
     assert mxu_fft._split(640) == (20, 32)

@@ -29,6 +29,11 @@ from .parallel.mesh import (
     make_pencil_mesh,
     make_slab_mesh,
 )
+from .parallel.multihost import (
+    global_from_local,
+    maybe_initialize,
+    process_local_slices,
+)
 from .models.base import DistFFTPlan
 from .models.batched2d import Batched2DFFTPlan
 from .models.pencil import PencilFFTPlan
@@ -42,6 +47,7 @@ __all__ = [
     "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
     "make_slab_mesh", "Batched2DFFTPlan", "DistFFTPlan", "PencilFFTPlan",
     "PoissonSolver", "SlabFFTPlan",
+    "global_from_local", "maybe_initialize", "process_local_slices",
 ]
 
 __version__ = "0.1.0"

@@ -53,6 +53,12 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
     ap.add_argument("--emulate-devices", type=int,
                     default=int(os.environ.get("DFFT_EMULATE_DEVICES", "0")),
                     help="force N virtual CPU devices (0 = use real backend)")
+    ap.add_argument("--multihost", action="store_true",
+                    help="join the multi-controller runtime (one process per "
+                         "host; rendezvous via DFFT_COORDINATOR / "
+                         "DFFT_NUM_PROCESSES / DFFT_PROCESS_ID or TPU-pod "
+                         "autodetection; see parallel/multihost.py and "
+                         "jobs/tpu/scripts/). Perf testcases only (0, 2)")
     if pencil:
         ap.add_argument("--comm-method1", "-comm1", default="Peer2Peer",
                         help='"Peer2Peer" (XLA-scheduled redistribution) or '
@@ -81,6 +87,17 @@ def run_testcase(plan, args, dims=None) -> int:
     if fn is None:
         print(f"unknown testcase {args.testcase}", file=sys.stderr)
         return 2
+    import jax
+    if jax.process_count() > 1 and args.testcase not in (0, 2):
+        # Validation testcases compare against a host-side reference array,
+        # which no single controller holds in a multi-host run. Like the
+        # reference, validate at single-host scale (jobs/**/validation.json
+        # run small sizes) and benchmark at pod scale.
+        print("testcases 1/3/4 validate against a host-side reference and "
+              "need a single-controller run (use --emulate-devices or one "
+              "host); multi-host supports perf testcases 0 and 2",
+              file=sys.stderr)
+        return 2
     kwargs = {}
     if args.testcase in (0, 2, 3, 4):
         kwargs.update(iterations=args.iterations, warmup=args.warmup_rounds)
@@ -94,11 +111,17 @@ def run_testcase(plan, args, dims=None) -> int:
 
 
 def setup_backend(args) -> None:
-    """Apply device emulation before any jax backend use. Must be called
-    before the first jax device query."""
+    """Apply device emulation / multi-host rendezvous before any jax backend
+    use. Must be called before the first jax device query."""
     import jax
     if args.emulate_devices:
+        if getattr(args, "multihost", False):
+            raise SystemExit("--multihost and --emulate-devices are mutually "
+                             "exclusive (emulation is single-process)")
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.emulate_devices)
     if getattr(args, "double_prec", False):
         jax.config.update("jax_enable_x64", True)
+    if getattr(args, "multihost", False):
+        from ..parallel.multihost import maybe_initialize
+        maybe_initialize(require=True)

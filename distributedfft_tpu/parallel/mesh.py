@@ -24,10 +24,26 @@ SLAB_AXIS = "p"
 PENCIL_AXES = ("p1", "p2")
 
 
+def _topology_mesh(shape: Tuple[int, ...]):
+    """ICI/DCN-aware device ordering via ``mesh_utils.create_device_mesh``
+    when the mesh spans every device (the multi-host pod case, where naive
+    ``jax.devices()`` order would put mesh neighbors on different hosts and
+    push transpose traffic onto DCN). None when unavailable or partial."""
+    try:
+        from jax.experimental import mesh_utils
+        return mesh_utils.create_device_mesh(shape)
+    except Exception:
+        return None
+
+
 def make_slab_mesh(p: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
     """1D mesh over ``p`` devices (reference world == slab ranks)."""
     if devices is None:
         devices = jax.devices()
+        if p is None or p == len(devices):
+            dm = _topology_mesh((len(devices),))
+            if dm is not None:
+                return Mesh(dm, (SLAB_AXIS,))
     if p is None:
         p = len(devices)
     if p > len(devices):
@@ -39,9 +55,13 @@ def make_pencil_mesh(p1: int, p2: int, devices: Optional[Sequence] = None) -> Me
     """2D mesh; axis ``p1`` is the column sub-communicator (second transpose),
     ``p2`` the row sub-communicator (first transpose), matching the
     reference's ``comm1``/``comm2`` split (``src/pencil/mpicufft_pencil.cpp:112-123``)."""
+    need = p1 * p2
     if devices is None:
         devices = jax.devices()
-    need = p1 * p2
+        if need == len(devices):
+            dm = _topology_mesh((p1, p2))
+            if dm is not None:
+                return Mesh(dm, PENCIL_AXES)
     if need > len(devices):
         raise ValueError(f"requested {p1}x{p2} pencil grid but only {len(devices)} devices")
     return Mesh(np.asarray(devices[:need]).reshape(p1, p2), PENCIL_AXES)

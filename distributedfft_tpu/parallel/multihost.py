@@ -1,0 +1,183 @@
+"""Multi-host (multi-process) runtime — the analog of the reference's
+MPI-over-SLURM multi-node layer.
+
+The reference scales past one node by launching one MPI rank per GPU with
+``mpiexec`` under SLURM (``jobs/**/slurm_scripts/*.sbatch``, up to 8 nodes x
+8 GPUs, ``run_pencil_8_large.sbatch:2-8``); ranks discover each other through
+MPI and exchange via NCCL-backed point-to-point/collective calls. On TPU the
+same role is played by JAX's multi-controller runtime: one Python process per
+host, ``jax.distributed.initialize`` for rendezvous, and afterwards
+``jax.devices()`` spans the whole pod so the ordinary mesh + collective path
+(``parallel/mesh.py``, ``parallel/transpose.py``) scales across hosts with
+ZERO changes to the plan code — XLA routes the same ``all_to_all`` over
+ICI within a host and DCN between hosts.
+
+What this module adds on top of `jax.distributed`:
+
+* ``maybe_initialize()`` — env-driven rendezvous (no-op single-process), the
+  analog of ``MPI_Init`` + rank discovery from the launcher environment;
+* per-process data plumbing: in a multi-controller program each process
+  holds only its slice of a global array. ``process_local_slices`` says
+  which logical slab/pencil block this process owns and
+  ``global_from_local`` assembles a sharded global ``jax.Array`` from the
+  process-local block (the analog of each MPI rank cudaMalloc'ing and
+  filling only its own partition — testcase inputs are generated per-rank,
+  ``tests/src/slab/random_dist_default.cu:174-190``).
+
+Launch scripts for TPU pods live in ``jobs/tpu/scripts/`` (the SLURM-script
+analog).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+_INITIALIZED = False
+
+# Environment contract (set by the pod launch scripts; every var optional —
+# on GCP TPU pods jax.distributed.initialize() autodetects all three).
+ENV_COORD = "DFFT_COORDINATOR"      # "host:port" of process 0
+ENV_NPROCS = "DFFT_NUM_PROCESSES"
+ENV_PROCID = "DFFT_PROCESS_ID"
+
+
+def maybe_initialize(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     require: bool = False) -> Tuple[int, int]:
+    """Join the multi-controller runtime if configured; returns
+    ``(process_index, process_count)``.
+
+    Resolution order: explicit args > ``DFFT_*`` env vars > autodetection
+    (``jax.distributed.initialize()`` with no args — on Cloud TPU pods the
+    coordinator and process ids come from instance metadata). Without
+    ``require``, when neither args nor env are present this stays
+    single-process and returns (0, 1) without touching the distributed
+    runtime — safe to call unconditionally, like the reference's guarded
+    ``MPI_Init_thread`` (``tests/src/slab/random_dist_default.cu:158-162``).
+    With ``require=True`` (the CLI ``--multihost`` flag: the user explicitly
+    asked for a multi-controller run) the bare autodetecting initialize is
+    attempted instead, so a pod worker joins the pod-wide runtime and a
+    misconfigured host fails loudly rather than silently benchmarking a
+    single-host FFT.
+    """
+    global _INITIALIZED
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORD)
+    if num_processes is None and os.environ.get(ENV_NPROCS):
+        num_processes = int(os.environ[ENV_NPROCS])
+    if process_id is None and os.environ.get(ENV_PROCID):
+        process_id = int(os.environ[ENV_PROCID])
+
+    autodetect = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if not (coordinator_address or autodetect or require):
+        return jax.process_index(), jax.process_count()
+    if not _INITIALIZED:
+        if coordinator_address:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        else:
+            jax.distributed.initialize()  # autodetect (TPU pod metadata)
+        _INITIALIZED = True
+    return jax.process_index(), jax.process_count()
+
+
+def shutdown() -> None:
+    """Leave the multi-controller runtime (reference ``MPI_Finalize``)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        jax.distributed.shutdown()
+        _INITIALIZED = False
+
+
+def is_primary() -> bool:
+    """True on the process that should write CSVs / print results (the
+    analog of the reference's rank-0 / ``p_gather`` role)."""
+    return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# Per-process data plumbing
+# ---------------------------------------------------------------------------
+
+
+def process_local_slices(sharding, global_shape) -> List[Tuple[slice, ...]]:
+    """Index tuples of ``global_shape`` owned by THIS process's addressable
+    devices, in device order. Use to generate/load only the local block of a
+    global input (each reference rank fills only its partition)."""
+    # addressable_devices is a set; order by device id for determinism.
+    devs = sorted(sharding.addressable_devices, key=lambda d: d.id)
+    index_map = sharding.addressable_devices_indices_map(tuple(global_shape))
+    return [index_map[d] for d in devs]
+
+
+def global_from_local(sharding, global_shape, local_block: np.ndarray):
+    """Assemble a global sharded ``jax.Array`` from this process's block.
+
+    ``local_block`` must be the concatenation of this process's shards along
+    the sharded axis (for one device per process: exactly the block given by
+    ``process_local_slices``). This is the multi-controller replacement for
+    ``jax.device_put(global_array, sharding)``, which needs the full global
+    array on every host.
+    """
+    return jax.make_array_from_process_local_data(
+        sharding, local_block, global_shape=tuple(global_shape))
+
+
+def _local_box_shape(sharding, shape) -> Tuple[int, ...]:
+    """Bounding box of this process's shards in every dim (slab shards dim
+    0; pencil shards dims 0 and 1)."""
+    slices = process_local_slices(sharding, shape)
+    return tuple(
+        max(s[d].stop if s[d].stop is not None else shape[d] for s in slices)
+        - min(s[d].start or 0 for s in slices)
+        for d in range(len(shape)))
+
+
+def _plan_dtypes(plan):
+    from ..ops.fft import dtypes_for
+    return dtypes_for(plan.config.double_prec)
+
+
+def plan_local_input(plan, seed: int = 0):
+    """Per-process random padded input for ``plan`` (multi-host testcase 0:
+    each process fills only its own block, like each reference rank's
+    cuRAND generate, ``tests/src/slab/random_dist_default.cu:174-190``).
+    Generated in the plan's precision (``--double`` included)."""
+    rdt, _ = _plan_dtypes(plan)
+    sharding = plan.input_sharding
+    shape = plan.input_padded_shape
+    if sharding is None:  # fft3d single-process fallback
+        rng = np.random.default_rng(seed)
+        return jax.device_put(rng.random(shape).astype(rdt))
+    rng = np.random.default_rng(seed + jax.process_index())
+    local = rng.random(_local_box_shape(sharding, shape)).astype(rdt)
+    return global_from_local(sharding, shape, local)
+
+
+def plan_local_spectral(plan, seed: int = 0, dims: int = 3):
+    """Per-process random padded spectral input (multi-host testcase 2), in
+    the plan's precision. ``dims`` is the pencil partial-dim depth
+    (reference ``--fft-dim``); full-3D plans ignore it."""
+    _, cdt = _plan_dtypes(plan)
+    if hasattr(plan, "output_sharding_for"):  # pencil: dims-dependent layout
+        sharding = plan.output_sharding_for(dims)
+        shape = plan.output_padded_shape_for(dims)
+    else:
+        sharding = plan.output_sharding
+        shape = plan.output_padded_shape
+    rng = np.random.default_rng(seed + jax.process_index())
+    if sharding is None:
+        local_shape = shape
+    else:
+        local_shape = _local_box_shape(sharding, shape)
+    local = (rng.random(local_shape) + 1j * rng.random(local_shape)
+             ).astype(cdt)
+    if sharding is None:
+        return jax.device_put(local)
+    return global_from_local(sharding, shape, local)

@@ -135,7 +135,14 @@ def _run_staged(plan, stages, timer: Timer, x, warmup: int, iterations: int,
 def testcase0(plan, iterations: int = 1, warmup: int = 0, seed: int = 0,
               write_csv: bool = True, dims: int = 3) -> Dict:
     """Forward perf (reference testcase 0)."""
-    x = plan.pad_input(jnp.asarray(random_real_input(plan, seed)))
+    if jax.process_count() > 1:
+        # Multi-controller run: each process fills only its own block, like
+        # each reference rank's local cuRAND generate
+        # (tests/src/slab/random_dist_default.cu:174-190).
+        from ..parallel.multihost import plan_local_input
+        x = plan_local_input(plan, seed)
+    else:
+        x = plan.pad_input(jnp.asarray(random_real_input(plan, seed)))
     timer = make_timer(plan, write_csv)
     stages = _stages(plan, "fwd", dims)
     _, times = _run_staged(plan, stages, timer, x, warmup, iterations)
@@ -160,12 +167,17 @@ def testcase1(plan, seed: int = 0, write_csv: bool = True,
 def testcase2(plan, iterations: int = 1, warmup: int = 0, seed: int = 0,
               write_csv: bool = True, dims: int = 3) -> Dict:
     """Inverse perf on random spectral input (testcase 2)."""
-    _, cdt = _dtypes(plan)
-    rng = np.random.default_rng(seed)
-    c = (rng.random(plan.output_shape) + 1j * rng.random(plan.output_shape))
-    c = jnp.asarray(c.astype(cdt))
-    c = (plan.pad_spectral(c, dims) if isinstance(plan, PencilFFTPlan)
-         else plan.pad_spectral(c))
+    if jax.process_count() > 1:
+        from ..parallel.multihost import plan_local_spectral
+        c = plan_local_spectral(plan, seed, dims=dims)
+    else:
+        _, cdt = _dtypes(plan)
+        rng = np.random.default_rng(seed)
+        c = (rng.random(plan.output_shape)
+             + 1j * rng.random(plan.output_shape))
+        c = jnp.asarray(c.astype(cdt))
+        c = (plan.pad_spectral(c, dims) if isinstance(plan, PencilFFTPlan)
+             else plan.pad_spectral(c))
     timer = make_timer(plan, write_csv)
     stages = _stages(plan, "inv", dims)
     _, times = _run_staged(plan, stages, timer, c, warmup, iterations)

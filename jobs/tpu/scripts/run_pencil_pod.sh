@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Pencil benchmark sweep across a Cloud TPU pod slice — analog of the
+# reference's run_pencil_8_large.sbatch (8 nodes x 8 GPUs, ntasks=64).
+# The pencil grid p1 x p2 must equal the pod's total chip count; the mesh
+# builder picks an ICI-aware device order (parallel/mesh.py) so transpose 1
+# (axis p2) rides ICI within hosts and transpose 2 crosses DCN.
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME}
+ZONE=${ZONE:?set ZONE}
+REPO=${REPO:-"~/repo"}
+P1=${P1:?set P1}   # e.g. 8 hosts
+P2=${P2:?set P2}   # e.g. 8 chips/host
+SIZES=${SIZES:-"2048"}
+ITERS=${ITERS:-20}
+WARMUP=${WARMUP:-10}
+
+for n in $SIZES; do
+  gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "cd $REPO && python -m distributedfft_tpu.cli.pencil \
+      -nx $n -ny $n -nz $n -p1 $P1 -p2 $P2 -t 0 -i $ITERS -w $WARMUP \
+      --multihost -b benchmarks/pod"
+done

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Slab benchmark sweep across all workers of a Cloud TPU pod slice — the
+# analog of the reference's SLURM scripts (jobs/**/slurm_scripts/*.sbatch,
+# e.g. run_slab.sbatch: module load + mpiexec over 2 nodes x 2 GPUs).
+#
+# On Cloud TPU VMs jax.distributed autodetects coordinator/process ids from
+# instance metadata, so every worker runs the SAME command:
+#
+#   TPU_NAME=my-pod ZONE=us-central2-b REPO=~/repo ./run_slab_pod.sh
+#
+# For non-GCP hosts, export the rendezvous env per host instead (the analog
+# of mpiexec's rank wiring):
+#   DFFT_COORDINATOR=host0:12355 DFFT_NUM_PROCESSES=4 DFFT_PROCESS_ID=<i>
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME}
+ZONE=${ZONE:?set ZONE}
+REPO=${REPO:-"~/repo"}
+SIZES=${SIZES:-"1024 2048"}
+ITERS=${ITERS:-20}
+WARMUP=${WARMUP:-10}
+
+for n in $SIZES; do
+  gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "cd $REPO && python -m distributedfft_tpu.cli.slab \
+      -nx $n -ny $n -nz $n -t 0 -i $ITERS -w $WARMUP --multihost \
+      -b benchmarks/pod"
+done

@@ -251,6 +251,101 @@ def _plot(results_rows, out: str) -> None:
         plt.close(fig)
 
 
+_RUNS_FILE_RE = re.compile(r"runs_(?P<opt>\d+)_(?P<p>\d+)_(?P<cuda>\d+)\.csv$")
+
+
+def scalability(eval_dir: str, size: str, out_path: "str | None" = None,
+                make_plot: bool = False) -> List[Tuple[str, int, int, float]]:
+    """Strong-scaling table from reduced runs CSVs — the analog of the
+    reference's ``eval/complete/scalability.py`` (best method per variant
+    across process counts, log2/log2 time-vs-P plot).
+
+    Scans ``<eval_dir>/<variant>/runs/runs_<opt>_<P>_<cuda>.csv`` for every
+    P, takes the best (minimum mean "Run complete") strategy at ``size``,
+    and emits rows ``variant,opt,P,best_ms,speedup,efficiency`` where
+    speedup/efficiency are relative to the smallest P of that series
+    (efficiency = t_Pmin * Pmin / (t_P * P)).
+    Returns the [(variant_opt_label, cuda, P, best_ms)] rows.
+    """
+    if not os.path.isdir(eval_dir):
+        print(f"no reduced eval outputs under {eval_dir}; run the reduction "
+              "first (scalability reads <eval>/<variant>/runs/)",
+              file=sys.stderr)
+        return []
+    series: Dict[Tuple[str, int, int], Dict[int, float]] = defaultdict(dict)
+    for variant in sorted(os.listdir(eval_dir)):
+        runs_dir = os.path.join(eval_dir, variant, "runs")
+        if not os.path.isdir(runs_dir):
+            continue
+        for fname in sorted(os.listdir(runs_dir)):
+            m = _RUNS_FILE_RE.match(fname)
+            if not m:
+                continue
+            opt, p, cuda = (int(m["opt"]), int(m["p"]), int(m["cuda"]))
+            with open(os.path.join(runs_dir, fname)) as f:
+                lines = [l.rstrip("\n") for l in f if l.strip()]
+            cols = lines[0].split(",")
+            try:
+                idx = cols.index(size)
+            except ValueError:
+                continue
+            best = None
+            for row in lines[1:]:
+                cells = row.split(",")
+                if idx < len(cells) and cells[idx]:
+                    v = float(cells[idx])
+                    best = v if best is None else min(best, v)
+            if best is not None:
+                series[(variant, opt, cuda)][p] = best
+
+    rows = []
+    out_lines = ["variant,opt,cuda,P,best_ms,speedup,efficiency"]
+    for (variant, opt, cuda), by_p in sorted(series.items()):
+        ps = sorted(by_p)
+        p0, t0 = ps[0], by_p[ps[0]]
+        for p in ps:
+            t = by_p[p]
+            speedup = t0 / t
+            eff = (t0 * p0) / (t * p)
+            label = f"{variant}_{'realigned' if opt else 'default'}"
+            rows.append((label, cuda, p, t))
+            out_lines.append(
+                f"{label},{opt},{cuda},{p},{t!r},{speedup!r},{eff!r}")
+
+    if out_path is None:
+        out_path = os.path.join(eval_dir, f"scalability_{size}.csv")
+    with open(out_path, "w") as f:
+        f.write(f"size,{size}\n" + "\n".join(out_lines) + "\n")
+
+    if make_plot and series:
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            print("matplotlib unavailable; skipping scalability plot",
+                  file=sys.stderr)
+            return rows
+        fig, ax = plt.subplots(figsize=(8, 5))
+        multi_cuda = len({c for _, _, c in series}) > 1
+        for (variant, opt, cuda), by_p in sorted(series.items()):
+            ps = sorted(by_p)
+            label = f"{variant}_{'realigned' if opt else 'default'}"
+            if multi_cuda:
+                label += f"_cuda{cuda}"
+            ax.plot(ps, [by_p[p] for p in ps], marker="o", label=label)
+        ax.set_xscale("log", base=2)
+        ax.set_yscale("log", base=2)
+        ax.set_xlabel("devices P")
+        ax.set_ylabel('best "Run complete" [ms]')
+        ax.set_title(f"Strong scaling, {size}")
+        ax.grid(True, color="grey", alpha=0.4)
+        ax.legend(fontsize=8)
+        fig.savefig(os.path.splitext(out_path)[0] + ".png", dpi=120)
+        plt.close(fig)
+    return rows
+
+
 def numerical_results(log_dir: str, out_path: str) -> int:
     """Parse ``Result`` lines from launcher stdout logs (.out/.txt) into an
     accuracy table — the analog of ``eval/complete/numerical_results.py``
@@ -283,12 +378,19 @@ def main(argv=None) -> int:
     ap.add_argument("--plots", action="store_true")
     ap.add_argument("--logs", default=None,
                     help="also parse Result lines from this log dir")
+    ap.add_argument("--scalability", default=None, metavar="SIZE",
+                    help='also emit a strong-scaling table/plot for this '
+                         'size label (e.g. "1024_1024_1024") across all '
+                         'reduced process counts')
     args = ap.parse_args(argv)
     out = args.out or os.path.join(args.prefix, "eval")
     reduce_prefix(args.prefix, out, make_plots=args.plots)
     if args.logs:
         n = numerical_results(args.logs, os.path.join(out, "numerical_results.csv"))
         print(f"parsed {n} Result lines")
+    if args.scalability:
+        rows = scalability(out, args.scalability, make_plot=args.plots)
+        print(f"scalability: {len(rows)} rows for size {args.scalability}")
     print(f"eval written to {out}")
     return 0
 

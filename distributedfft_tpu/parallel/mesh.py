@@ -32,7 +32,11 @@ def _topology_mesh(shape: Tuple[int, ...]):
     try:
         from jax.experimental import mesh_utils
         return mesh_utils.create_device_mesh(shape)
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — any failure degrades, visibly
+        import sys
+        print(f"warning: topology-aware device mesh unavailable ({e!r}); "
+              "falling back to enumeration order — on a multi-host pod this "
+              "can route transpose traffic over DCN", file=sys.stderr)
         return None
 
 

@@ -75,6 +75,13 @@ def maybe_initialize(coordinator_address: Optional[str] = None,
 
     autodetect = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if not (coordinator_address or autodetect or require):
+        if num_processes is not None or process_id is not None:
+            # Partial DFFT_* config (count/id but no coordinator) means a
+            # misconfigured launch — fail loudly rather than silently
+            # benchmarking a single host with pod-sized metadata.
+            raise ValueError(
+                f"{ENV_NPROCS}/{ENV_PROCID} are set but {ENV_COORD} is not; "
+                "set the coordinator address (host:port of process 0)")
         return jax.process_index(), jax.process_count()
     if not _INITIALIZED:
         if coordinator_address:

@@ -52,16 +52,17 @@ class TestLauncher:
         assert "-nx 16 -ny 16 -nz 16" in out
 
 
-def _write_fake_csvs(bench_dir, variant, combos, sizes, iters=3, seed=0):
+def _write_fake_csvs(bench_dir, variant, combos, sizes, iters=3, seed=0,
+                     p=8, time_scale=1.0):
     rng = np.random.default_rng(seed)
     descs = ["init", "first", "xpose", "last", "Run complete"]
     for (opt, comm, snd) in combos:
         for (nx, ny, nz) in sizes:
-            fname = f"test_{opt}_{comm}_{snd}_{nx}_{ny}_{nz}_0_8.csv"
-            t = Timer(descs, 8, os.path.join(bench_dir, variant, fname))
+            fname = f"test_{opt}_{comm}_{snd}_{nx}_{ny}_{nz}_0_{p}.csv"
+            t = Timer(descs, p, os.path.join(bench_dir, variant, fname))
             for _ in range(iters):
                 t.start()
-                base = 1.0 + rng.random()
+                base = (1.0 + rng.random()) * time_scale
                 t._durations = {"first": base, "xpose": base * 2,
                                 "last": base * 3, "Run complete": base * 3.1}
                 t.gather()
@@ -98,6 +99,28 @@ class TestEvalKit:
         assert d["first"] == 2.0
         assert d["xpose"] == 3.0
         assert d["last"] == 1.0
+
+    def test_scalability(self, tmp_path):
+        """Perfect 1/P timing must reduce to efficiency ~1 across P."""
+        bench = str(tmp_path / "bench")
+        # Same seed -> identical base times, scaled exactly 1/P.
+        _write_fake_csvs(bench, "slab_default", [(0, 0, 0)],
+                         [(16, 16, 16)], seed=5, p=4, time_scale=1.0)
+        _write_fake_csvs(bench, "slab_default", [(0, 0, 0)],
+                         [(16, 16, 16)], seed=5, p=8, time_scale=0.5)
+        out = str(tmp_path / "eval")
+        evaluate.reduce_prefix(bench, out)
+        rows = evaluate.scalability(out, "16_16_16")
+        assert [(p, round(t, 6)) for _, _, p, t in rows] == \
+            sorted((p, round(t, 6)) for _, _, p, t in rows)
+        lines = open(os.path.join(out, "scalability_16_16_16.csv")
+                     ).read().splitlines()
+        assert lines[0] == "size,16_16_16"
+        assert lines[1] == "variant,opt,cuda,P,best_ms,speedup,efficiency"
+        recs = [l.split(",") for l in lines[2:]]
+        assert [(r[3]) for r in recs] == ["4", "8"]
+        effs = [float(r[6]) for r in recs]
+        assert effs[0] == 1.0 and abs(effs[1] - 1.0) < 1e-9
 
     def test_numerical_results(self, tmp_path):
         log = tmp_path / "run.out"

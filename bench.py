@@ -35,7 +35,7 @@ def _deadline(sec):
     signal.alarm(sec)
 
 
-def roundtrip_chain(k: int, n: int):
+def roundtrip_chain(k: int, n: int, backend: str):
     """K roundtrips chained through a fori_loop, reduced to ONE scalar.
 
     The scalar is read back with ``float()`` — measured on the axon tunnel,
@@ -44,32 +44,46 @@ def roundtrip_chain(k: int, n: int):
     readback is a true completion fence. The readback's own large constant
     cost (~1.5 s through the tunnel) cancels in the (t_K - t_1)/(K - 1)
     difference.
+
+    Runs through the framework's local-FFT layer. The default backend is
+    "matmul" — the MXU four-step DFT (ops/mxu_fft.py), measured on v5e at
+    1.53 ms/roundtrip vs 4.89 ms for the XLA FFT expansion and 3.19 ms for
+    matmul at Precision.HIGHEST (fwd max rel err vs f64 truth: 8.2e-7).
+    Override with DFFT_BENCH_BACKEND=xla|matmul|pallas.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    from distributedfft_tpu.ops import fft as lf
+    from distributedfft_tpu.params import FFTNorm
+
     def body(i, v):
-        c = jnp.fft.rfftn(v)
-        # norm="forward" makes irfftn unnormalized; dividing by N^3 keeps
-        # the chained value bounded so the loop cannot overflow.
-        return jnp.fft.irfftn(c, s=v.shape, norm="forward") / float(n) ** 3
+        c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend)
+        # FFTNorm.NONE leaves both directions unnormalized (the cuFFT
+        # convention); dividing by N^3 keeps the chained value bounded so
+        # the loop cannot overflow.
+        r = lf.irfftn_3d(c, (n, n, n), norm=FFTNorm.NONE, backend=backend)
+        return r / float(n) ** 3
 
     return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
 
 
 def main() -> int:
     _deadline(DEADLINE_S)
+    import os
+
     import numpy as np
 
     import jax
 
+    backend = os.environ.get("DFFT_BENCH_BACKEND", "matmul")
     platform = jax.devices()[0].platform
     x = jax.device_put(np.random.default_rng(0).random((N, N, N))
                        .astype(np.float32))
 
     def timed(k: int) -> float:
-        fn = roundtrip_chain(k, N)
+        fn = roundtrip_chain(k, N, backend)
         float(fn(x))  # compile + warm (scalar readback = completion fence)
         best = float("inf")
         for _ in range(5):
@@ -98,6 +112,7 @@ def main() -> int:
 
     result = {
         "metric": f"single-chip 256^3 f32 R2C+C2R roundtrip ms on {platform} "
+                  f"[{backend} backend] "
                   f"(vs argon single-GPU f64 cufftPlan3d {BASELINE_ROUNDTRIP_MS} ms; "
                   f"vs_baseline = baseline/ours, >1 is faster)",
         "value": round(per_iter_ms, 4),

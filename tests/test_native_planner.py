@@ -54,3 +54,79 @@ class TestNativeParity:
         # 8 devices: 7/8 of the volume crosses the wire (diagonal stays).
         total = 16 * 16 * 9 * 8
         assert npl.transpose_wire_bytes((16, 16, 9), 8, 8) == total - total // 8
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="native planner not built (make -C native)")
+class TestNativeTimerCSV:
+    """native/timer.cpp must emit byte-identical CSV to the real Python
+    fallback writer in Timer.gather() (reference schema, src/timer.cpp:58-102).
+    Values cover every repr notation branch: fractional, zero, integral
+    (100.0/42.0 — %g would print '1e+02'), subnormal-exponent scientific,
+    shortest-17-digit, and large fixed/scientific boundary cases."""
+
+    DURATIONS = [("2D FFT Y-Z-Direction", 1.25), ("Transpose (First Send)", 0.0),
+                 ("Run complete", 42.0), ("Transpose (Finished Receive)", 100.0),
+                 ("1D FFT X-Direction", 1000.5), ("Finished", 3.0517578125e-05),
+                 ("odd", 0.1 + 0.2), ("huge", 1.5e+17), ("edge", 1e+16),
+                 ("fixed-edge", 1e+15), ("tiny", 1.25e-05)]
+
+    def _gather_bytes(self, tmp_path, name, blocks, monkeypatch, native):
+        """Drive the REAL Timer.gather() writer, with the native path either
+        active or monkeypatched away (so the Python fallback runs)."""
+        from distributedfft_tpu.utils import timer as timer_mod
+
+        path = tmp_path / name
+        if not native:
+            monkeypatch.setattr(timer_mod.native_planner, "timer_csv_append",
+                                lambda *a, **k: None)
+        t = timer_mod.Timer([d for d, _ in self.DURATIONS], pcnt=4,
+                            filename=str(path))
+        for _ in range(blocks):
+            t.start()
+            t._durations = dict(self.DURATIONS)
+            t.gather()
+        monkeypatch.undo()
+        return path.read_bytes()
+
+    def test_byte_identical_blocks(self, tmp_path, monkeypatch):
+        nat = self._gather_bytes(tmp_path, "native.csv", 3, monkeypatch,
+                                 native=True)
+        py = self._gather_bytes(tmp_path, "py.csv", 3, monkeypatch,
+                                native=False)
+        assert b"1e+02" not in nat  # integral values must render as repr
+        assert nat == py
+
+    def test_timer_gather_uses_native_and_parses(self, tmp_path):
+        from distributedfft_tpu.utils.timer import Timer, read_timer_csv
+        path = tmp_path / "t" / "gather.csv"
+        t = Timer(["a", "b"], pcnt=2, filename=str(path))
+        t.start()
+        t.stop_store("a")
+        t.stop_store("b")
+        t.gather()
+        blocks = read_timer_csv(str(path))
+        assert len(blocks) == 1 and set(blocks[0]) == {"a", "b"}
+        assert len(blocks[0]["a"]) == 2
+
+    def test_locale_independent(self, tmp_path, monkeypatch):
+        """The native writer must emit '.' decimals even under a locale
+        whose separator is ',' (the CSV delimiter)."""
+        import locale
+        comma_locale = None
+        for name in ("de_DE.UTF-8", "fr_FR.UTF-8", "de_DE", "fr_FR"):
+            try:
+                locale.setlocale(locale.LC_NUMERIC, name)
+                if locale.localeconv()["decimal_point"] == ",":
+                    comma_locale = name
+                    break
+            except locale.Error:
+                continue
+        if comma_locale is None:
+            locale.setlocale(locale.LC_NUMERIC, "C")
+            pytest.skip("no comma-decimal locale available")
+        try:
+            path = tmp_path / "locale.csv"
+            assert npl.timer_csv_append(str(path), [("a", 1.25)], 2)
+            assert b"1.25,1.25," in path.read_bytes()
+        finally:
+            locale.setlocale(locale.LC_NUMERIC, "C")

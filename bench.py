@@ -23,7 +23,14 @@ import sys
 import time
 
 N = 256
-K = 33
+# K must be large enough that (K-1) roundtrips of work dominate the axon
+# tunnel's run-to-run latency noise: measured constants fluctuate by tens of
+# ms between processes, which at K=33 (~50 ms of work) produced reported
+# values anywhere in 0.4-3.1 ms for the same code. K=257 puts ~400 ms of
+# work in the difference; combined with the median over REPEATS (t_K - t_1)
+# pairs the spread collapses to a few percent.
+K = 257
+REPEATS = 3
 BASELINE_ROUNDTRIP_MS = 4.4  # 2 x 2.20 ms (argon single-GPU 256^3 inverse, f64)
 DEADLINE_S = 480
 
@@ -82,19 +89,23 @@ def main() -> int:
     x = jax.device_put(np.random.default_rng(0).random((N, N, N))
                        .astype(np.float32))
 
-    def timed(k: int) -> float:
-        fn = roundtrip_chain(k, N, backend)
-        float(fn(x))  # compile + warm (scalar readback = completion fence)
+    fn1 = roundtrip_chain(1, N, backend)
+    fnK = roundtrip_chain(K, N, backend)
+    float(fn1(x))  # compile + warm (scalar readback = completion fence)
+    float(fnK(x))
+
+    def timed(fn) -> float:
         best = float("inf")
-        for _ in range(5):
+        for _ in range(3):
             t0 = time.perf_counter()
             float(fn(x))
             best = min(best, time.perf_counter() - t0)
         return best
 
-    t1 = timed(1)
-    tk = timed(K)
-    per_iter_ms = (tk - t1) / (K - 1) * 1e3
+    pairs = [(timed(fnK), timed(fn1)) for _ in range(REPEATS)]
+    t1 = pairs[-1][1]  # a 1-iteration sample, reused by the fallback below
+    diffs = sorted(tk - t1_i for tk, t1_i in pairs)
+    per_iter_ms = diffs[len(diffs) // 2] / (K - 1) * 1e3
     degenerate = per_iter_ms <= 0
     if degenerate:
         # Constant overheads swamped the K-vs-1 difference. t1 includes the

@@ -53,6 +53,11 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
     ap.add_argument("--emulate-devices", type=int,
                     default=int(os.environ.get("DFFT_EMULATE_DEVICES", "0")),
                     help="force N virtual CPU devices (0 = use real backend)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace of the testcase run to "
+                         "this directory (view with TensorBoard / Perfetto) — "
+                         "the deep-dive complement to the per-phase Timer "
+                         "CSVs, SURVEY §5 tracing")
     ap.add_argument("--multihost", action="store_true",
                     help="join the multi-controller runtime (one process per "
                          "host; rendezvous via DFFT_COORDINATOR / "
@@ -103,7 +108,12 @@ def run_testcase(plan, args, dims=None) -> int:
         kwargs.update(iterations=args.iterations, warmup=args.warmup_rounds)
     if dims is not None and args.testcase != 4:
         kwargs["dims"] = dims
-    result = fn(plan, **kwargs)
+    profile_dir = getattr(args, "profile_dir", None)
+    if profile_dir:
+        with jax.profiler.trace(profile_dir):
+            result = fn(plan, **kwargs)
+    else:
+        result = fn(plan, **kwargs)
     if "mean_ms" in result:
         print(f"Run complete: {result['mean_ms']:.4f} ms "
               f"(mean over {args.iterations} iterations)")

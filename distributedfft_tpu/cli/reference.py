@@ -35,11 +35,21 @@ def main(argv=None) -> int:
 
     import jax
     import numpy as np
-    from ..testing import microbench as mb
 
     shape = (args.input_dim_x, args.input_dim_y, args.input_dim_z)
     dtype = np.float64 if args.double_prec else np.float32
     it, wu = args.iterations, args.warmup_rounds
+
+    if args.profile_dir:
+        with jax.profiler.trace(args.profile_dir):
+            return _dispatch(args, shape, dtype, it, wu)
+    return _dispatch(args, shape, dtype, it, wu)
+
+
+def _dispatch(args, shape, dtype, it, wu) -> int:
+    import jax
+
+    from ..testing import microbench as mb
 
     if args.testcase == 0:
         ms = mb.single_device_fft_ms(shape, it, wu, dtype,

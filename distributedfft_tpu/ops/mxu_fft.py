@@ -128,10 +128,39 @@ def _split(n: int) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
+# Complex matmul strategy for the C2C stages. XLA decomposes a complex dot
+# into 4 real matmuls (ArFr - AiFi, ArFi + AiFr); the Karatsuba-style
+# 3-multiplication form (t1=ArFr, t2=AiFi, t3=(Ar+Ai)(Fr+Fi); Re=t1-t2,
+# Im=t3-t1-t2) trades one matmul for two extra additions. Measured on v5e
+# at 256^3 it is a net LOSS (~1.9-2.2 ms roundtrip vs ~1.5 ms): at these
+# sizes the stages are close to HBM-bound, so trimming MXU passes while
+# adding elementwise operand traffic costs more than it saves. Off by
+# default; the toggle stays as a benchmarkable axis for larger / more
+# compute-bound shapes.
+_KARATSUBA = False
+
+
+def set_karatsuba(on: bool) -> None:
+    """Toggle the 3-matmul complex-multiply form (trace-time flag, like
+    ``set_precision``)."""
+    global _KARATSUBA
+    _KARATSUBA = bool(on)
+
+
 def _matmul_F(x, F_np: np.ndarray):
     """x @ F for complex x and a constant complex DFT matrix."""
-    F = jnp.asarray(F_np)
-    return jnp.matmul(x, F, precision=_prec_for(x.dtype))
+    prec = _prec_for(x.dtype)
+    if not _KARATSUBA:
+        return jnp.matmul(x, jnp.asarray(F_np), precision=prec)
+    rdt = np.float64 if _is_double(x.dtype) else np.float32
+    Fr = jnp.asarray(np.ascontiguousarray(F_np.real.astype(rdt)))
+    Fi = jnp.asarray(np.ascontiguousarray(F_np.imag.astype(rdt)))
+    Fs = jnp.asarray((F_np.real + F_np.imag).astype(rdt))
+    ar, ai = jnp.real(x), jnp.imag(x)
+    t1 = jnp.matmul(ar, Fr, precision=prec)
+    t2 = jnp.matmul(ai, Fi, precision=prec)
+    t3 = jnp.matmul(ar + ai, Fs, precision=prec)
+    return lax.complex(t1 - t2, t3 - t1 - t2)
 
 
 def _rmatmul_F(x_real, F_np: np.ndarray):

@@ -131,3 +131,18 @@ class TestEvalKit:
         n = evaluate.numerical_results(str(tmp_path), out)
         assert n == 2
         assert "Result (avg)" in open(out).read()
+
+
+class TestProfileDir:
+    def test_slab_cli_writes_profiler_trace(self, tmp_path, monkeypatch):
+        """--profile-dir wraps the testcase in jax.profiler.trace (SURVEY §5
+        tracing: the deep-dive complement to the Timer CSVs)."""
+        from distributedfft_tpu.cli import slab as slab_cli
+
+        monkeypatch.chdir(tmp_path)
+        rc = slab_cli.main(["-nx", "16", "-ny", "16", "-nz", "16", "-p", "4",
+                            "-t", "3", "-i", "1",
+                            "--profile-dir", str(tmp_path / "trace")])
+        assert rc == 0
+        found = list((tmp_path / "trace").rglob("*.xplane.pb"))
+        assert found, "no xplane trace written under --profile-dir"

@@ -119,3 +119,32 @@ def test_plan_with_matmul_backend(family, devices, rng):
 def test_config_rejects_unknown_backend():
     with pytest.raises(ValueError):
         dfft.Config(fft_backend="cufft")
+
+
+def test_karatsuba_toggle_matches_4matmul(rng):
+    """The 3-matmul complex multiply must agree with the plain complex
+    matmul path to f64 tightness (both run the same DFT)."""
+    from distributedfft_tpu.ops import mxu_fft as mf
+    x = (rng.standard_normal((8, 64)) + 1j * rng.standard_normal((8, 64))
+         ).astype(np.complex128)
+    try:
+        mf.set_karatsuba(True)
+        a = np.asarray(mf.fft(x, axis=-1))
+        mf.set_karatsuba(False)
+        b = np.asarray(mf.fft(x, axis=-1))
+    finally:
+        mf.set_karatsuba(False)  # module default
+    assert _rel(a, b) < 1e-12
+    assert _rel(a, np.fft.fft(x, axis=-1)) < 1e-12
+
+
+def test_set_precision_accepts_names():
+    from jax import lax
+    from distributedfft_tpu.ops import mxu_fft as mf
+    try:
+        mf.set_precision("highest")
+        assert mf._PREC_SINGLE == lax.Precision.HIGHEST
+        mf.set_precision(lax.Precision.HIGH)
+        assert mf._PREC_SINGLE == lax.Precision.HIGH
+    finally:
+        mf.set_precision(lax.Precision.HIGH)

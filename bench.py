@@ -42,41 +42,19 @@ def _deadline(sec):
     signal.alarm(sec)
 
 
-def roundtrip_chain(k: int, n: int, backend: str):
-    """K roundtrips chained through a fori_loop, reduced to ONE scalar.
-
-    The scalar is read back with ``float()`` — measured on the axon tunnel,
-    ``jax.block_until_ready`` on an on-device array does NOT wait for an FFT
-    chain to finish (dispatch-only, ~0.07 ms for any K), while a scalar
-    readback is a true completion fence. The readback's own large constant
-    cost (~1.5 s through the tunnel) cancels in the (t_K - t_1)/(K - 1)
-    difference.
-
-    Runs through the framework's local-FFT layer. The default backend is
-    "matmul" — the MXU four-step DFT (ops/mxu_fft.py), measured on v5e at
-    1.53 ms/roundtrip vs 4.89 ms for the XLA FFT expansion and 3.19 ms for
-    matmul at Precision.HIGHEST (fwd max rel err vs f64 truth: 8.2e-7).
-    Override with DFFT_BENCH_BACKEND=xla|matmul|pallas.
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    from distributedfft_tpu.ops import fft as lf
-    from distributedfft_tpu.params import FFTNorm
-
-    def body(i, v):
-        c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend)
-        # FFTNorm.NONE leaves both directions unnormalized (the cuFFT
-        # convention); dividing by N^3 keeps the chained value bounded so
-        # the loop cannot overflow.
-        r = lf.irfftn_3d(c, (n, n, n), norm=FFTNorm.NONE, backend=backend)
-        return r / float(n) ** 3
-
-    return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
-
-
 def main() -> int:
+    """Times the framework's local-FFT layer via the shared chained-roundtrip
+    harness (distributedfft_tpu/testing/chaintimer.py: scalar-fenced jitted
+    fori_loop chain, median of (t_K - t_1) pairs — on the axon tunnel,
+    ``block_until_ready`` is dispatch-only and only a scalar readback truly
+    fences, and its ~1.5 s constant cancels in the pair difference).
+
+    The default backend is "matmul" — the MXU four-step DFT
+    (ops/mxu_fft.py), measured on v5e at 1.51 ms/roundtrip vs 4.89 ms for
+    the XLA FFT expansion and 3.19 ms for matmul at Precision.HIGHEST (fwd
+    max rel err vs f64 truth: 8.2e-7). Override with
+    DFFT_BENCH_BACKEND=xla|matmul|pallas.
+    """
     _deadline(DEADLINE_S)
     import os
 
@@ -84,28 +62,20 @@ def main() -> int:
 
     import jax
 
+    from distributedfft_tpu.testing import chaintimer
+
     backend = os.environ.get("DFFT_BENCH_BACKEND", "matmul")
     platform = jax.devices()[0].platform
     x = jax.device_put(np.random.default_rng(0).random((N, N, N))
                        .astype(np.float32))
 
-    fn1 = roundtrip_chain(1, N, backend)
-    fnK = roundtrip_chain(K, N, backend)
+    fn1 = chaintimer.roundtrip_chain(1, (N, N, N), backend)
+    fnK = chaintimer.roundtrip_chain(K, (N, N, N), backend)
     float(fn1(x))  # compile + warm (scalar readback = completion fence)
     float(fnK(x))
 
-    def timed(fn) -> float:
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(fn(x))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    pairs = [(timed(fnK), timed(fn1)) for _ in range(REPEATS)]
-    t1 = pairs[-1][1]  # a 1-iteration sample, reused by the fallback below
-    diffs = sorted(tk - t1_i for tk, t1_i in pairs)
-    per_iter_ms = diffs[len(diffs) // 2] / (K - 1) * 1e3
+    per_iter_ms, t1 = chaintimer.median_pair_diff_ms(
+        fn1, fnK, x, K, REPEATS, inner=3)
     degenerate = per_iter_ms <= 0
     if degenerate:
         # Constant overheads swamped the K-vs-1 difference. t1 includes the

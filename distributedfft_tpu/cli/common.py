@@ -79,6 +79,18 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
         ap.add_argument("--send-method", "-snd", default="Sync")
 
 
+def maybe_profile(args):
+    """Context manager: a ``jax.profiler.trace`` over the block when
+    ``--profile-dir`` was given, a no-op otherwise (shared by all CLIs)."""
+    import contextlib
+
+    profile_dir = getattr(args, "profile_dir", None)
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(profile_dir)
+
+
 def run_testcase(plan, args, dims=None) -> int:
     """Dispatch -t N to the testcase implementations and print the perf
     summary; shared by the slab and pencil executables. ``dims`` is the
@@ -108,11 +120,7 @@ def run_testcase(plan, args, dims=None) -> int:
         kwargs.update(iterations=args.iterations, warmup=args.warmup_rounds)
     if dims is not None and args.testcase != 4:
         kwargs["dims"] = dims
-    profile_dir = getattr(args, "profile_dir", None)
-    if profile_dir:
-        with jax.profiler.trace(profile_dir):
-            result = fn(plan, **kwargs)
-    else:
+    with maybe_profile(args):
         result = fn(plan, **kwargs)
     if "mean_ms" in result:
         print(f"Run complete: {result['mean_ms']:.4f} ms "

@@ -16,7 +16,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .common import add_common_args, setup_backend
+from .common import add_common_args, maybe_profile, setup_backend
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -26,6 +26,18 @@ def build_parser() -> argparse.ArgumentParser:
     add_common_args(ap, pencil=False)
     ap.add_argument("--partition1", "-p1", type=int, default=0)
     ap.add_argument("--partition2", "-p2", type=int, default=0)
+    ap.add_argument("--autotune", action="store_true",
+                    help="race the local-FFT backends (xla / matmul@high / "
+                         "matmul@highest / pallas) for this shape on the "
+                         "current device and report the fastest that meets "
+                         "the accuracy budget")
+    ap.add_argument("--autotune-budget", type=float, default=1e-4,
+                    help="max roundtrip rel. error a backend may incur")
+    ap.add_argument("--autotune-k", type=int, default=257,
+                    help="chained roundtrips per timing sample; must be "
+                         "large enough that the work dominates the TPU "
+                         "tunnel's tens-of-ms constant noise (257 matches "
+                         "bench.py at 256^3; smaller is fine on CPU)")
     return ap
 
 
@@ -40,10 +52,27 @@ def main(argv=None) -> int:
     dtype = np.float64 if args.double_prec else np.float32
     it, wu = args.iterations, args.warmup_rounds
 
-    if args.profile_dir:
-        with jax.profiler.trace(args.profile_dir):
-            return _dispatch(args, shape, dtype, it, wu)
-    return _dispatch(args, shape, dtype, it, wu)
+    if args.autotune:
+        from ..testing import autotune as at
+        prec = "f64" if args.double_prec else "f32"
+        print(f"autotuning local FFT backends for {shape} {prec} on "
+              f"{jax.devices()[0].platform}:")
+        with maybe_profile(args):
+            ranked = at.autotune_local_fft(shape, args.autotune_budget,
+                                           k=args.autotune_k,
+                                           double_prec=args.double_prec,
+                                           verbose=True)
+        best = ranked[0]
+        if not best.ok:
+            print(f"no usable backend: {at.describe_failures(ranked)}",
+                  file=sys.stderr)
+            return 1
+        print(f"best: {best.label} ({best.per_iter_ms:.3f} ms/roundtrip, "
+              f"rel_err {best.rel_err:.2e})")
+        return 0
+
+    with maybe_profile(args):
+        return _dispatch(args, shape, dtype, it, wu)
 
 
 def _dispatch(args, shape, dtype, it, wu) -> int:

@@ -1,0 +1,160 @@
+"""Plan-time autotuning of the local-FFT backend.
+
+cuFFT benchmarks algorithm variants inside plan creation; the reference
+inherits that (its `cufftMakePlanMany64` picks kernels per shape,
+``src/slab/default/mpicufft_slab.cpp:137-167``) and spends its whole harness
+comparing comm-method variants. This module is the TPU rendering of both: for
+a given local shard shape it races the framework's interchangeable local-FFT
+backends (``ops/fft.py``: xla / matmul / pallas, and the matmul backend's MXU
+precision levels) ON THE CURRENT DEVICE, gates candidates on a round-trip
+accuracy budget, and returns the fastest — so ``Config.fft_backend`` can be
+chosen by measurement instead of folklore. Measured v5e example (256^3 f32
+roundtrip): xla 4.89 ms, matmul@HIGHEST 3.19 ms, matmul@HIGH 1.51 ms,
+pallas 5.16 ms — a 3.2x spread that no static default gets right on every
+platform (on CPU, xla wins by a similar margin).
+
+Timing comes from the shared chained-roundtrip harness
+(``testing/chaintimer.py``, also used by bench.py): median of (t_K - t_1)
+pairs of a scalar-fenced jitted fori_loop chain. On the TPU tunnel use
+``k`` large enough that the measured work dominates the tens-of-ms
+run-to-run noise (bench.py uses 257 at 256^3); a nonpositive median is
+reported as a degenerate measurement, not a timing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..params import FFTNorm
+from . import chaintimer
+
+
+@dataclass
+class Candidate:
+    backend: str
+    precision: Optional[str]  # matmul-only: "high" | "highest"
+    per_iter_ms: float = float("nan")
+    rel_err: float = float("nan")
+    ok: bool = False
+    error: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        return self.backend if self.precision is None \
+            else f"{self.backend}@{self.precision}"
+
+
+def _measure(shape, backend: str, k: int, repeats: int, inner: int,
+             x, x_absmax: float) -> Tuple[float, float, Optional[str]]:
+    """(per-iteration ms, roundtrip rel err, degeneracy note)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import fft as lf
+
+    # Accuracy: roundtrip error relative to the input's max magnitude,
+    # reduced to a scalar on device (array readback is unavailable through
+    # the TPU tunnel).
+    scale = 1.0 / float(np.prod(shape))
+    err_fn = jax.jit(lambda a: jnp.max(jnp.abs(
+        lf.irfftn_3d(lf.rfftn_3d(a, norm=FFTNorm.NONE, backend=backend),
+                     tuple(shape), norm=FFTNorm.NONE, backend=backend)
+        * scale - a)))
+    rel = float(err_fn(x)) / x_absmax
+
+    fn1 = chaintimer.roundtrip_chain(1, shape, backend)
+    fnK = chaintimer.roundtrip_chain(k, shape, backend)
+    float(fn1(x))  # compile + warm
+    float(fnK(x))
+    per_ms, _ = chaintimer.median_pair_diff_ms(fn1, fnK, x, k, repeats, inner)
+    if per_ms <= 0:
+        return per_ms, rel, (f"degenerate timing (median t_K-t_1 <= 0 at "
+                             f"k={k}; raise k so the work dominates noise)")
+    return per_ms, rel, None
+
+
+def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
+                       k: int = 257, repeats: int = 3, inner: int = 3,
+                       backends: Optional[Sequence[str]] = None,
+                       double_prec: bool = False,
+                       seed: int = 0, verbose: bool = False) -> List[Candidate]:
+    """Race the local-FFT backends for a 3D R2C+C2R roundtrip of ``shape``
+    on the current default device.
+
+    ``double_prec`` races the f64 path instead (requires ``jax_enable_x64``;
+    the matmul backend then always runs at HIGHEST, so only one matmul
+    candidate is raced). Returns candidates sorted fastest-first; entries
+    failing the accuracy budget, measuring degenerately, or crashing have
+    ``ok=False`` (with ``error`` set for the latter two) and sort last.
+    Apply the winner with ``apply_best``.
+    """
+    import jax
+
+    from ..ops import fft as lf
+    from ..ops import mxu_fft
+
+    if backends is None:
+        backends = lf.BACKENDS
+    dt = np.float64 if double_prec else np.float32
+    xs = np.random.default_rng(seed).random(tuple(shape)).astype(dt)
+    x_absmax = float(np.abs(xs).max()) or 1.0
+    x = jax.device_put(xs)
+
+    cands: List[Candidate] = []
+    for b in backends:
+        if b == "matmul" and not double_prec:
+            cands += [Candidate("matmul", "high"),
+                      Candidate("matmul", "highest")]
+        else:
+            cands.append(Candidate(b, None))
+
+    saved_prec = mxu_fft._PREC_SINGLE
+    try:
+        for c in cands:
+            if c.precision is not None:
+                mxu_fft.set_precision(c.precision)
+            try:
+                c.per_iter_ms, c.rel_err, c.error = _measure(
+                    shape, c.backend, k, repeats, inner, x, x_absmax)
+                c.ok = (c.error is None and c.rel_err <= budget_rel_err)
+            except Exception as e:  # backend unavailable on this platform
+                c.error = f"{type(e).__name__}: {e}"
+            if verbose:
+                print(f"  {c.label:16s} {c.per_iter_ms:8.3f} ms  "
+                      f"rel_err {c.rel_err:.2e}  ok={c.ok}"
+                      + (f"  ({c.error})" if c.error else ""), flush=True)
+    finally:
+        mxu_fft._PREC_SINGLE = saved_prec
+
+    return sorted(cands, key=lambda c: (not c.ok, c.per_iter_ms))
+
+
+def describe_failures(candidates: List[Candidate]) -> str:
+    """Human-readable reason per non-ok candidate (crash/degenerate vs
+    accuracy), so a failed tune is diagnosed correctly."""
+    parts = []
+    for c in candidates:
+        if c.ok:
+            continue
+        parts.append(f"{c.label}: {c.error}" if c.error
+                     else f"{c.label}: rel_err {c.rel_err:.2e} over budget")
+    return "; ".join(parts)
+
+
+def apply_best(candidates: List[Candidate]):
+    """Translate the winning candidate into a ``Config`` (and set the MXU
+    precision global when the winner is a matmul variant). Raises when no
+    candidate passed."""
+    from ..ops import mxu_fft
+    from ..params import Config
+
+    best = candidates[0]
+    if not best.ok:
+        raise RuntimeError(
+            f"autotune: no usable backend; {describe_failures(candidates)}")
+    if best.precision is not None:
+        mxu_fft.set_precision(best.precision)
+    return Config(fft_backend=best.backend)

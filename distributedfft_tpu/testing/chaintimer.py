@@ -1,0 +1,66 @@
+"""Shared chained-roundtrip timing harness (bench.py + testing/autotune.py).
+
+Methodology (hardened for the TPU tunnel, where ``block_until_ready`` on a
+device array is dispatch-only and only a scalar readback is a true
+completion fence): K roundtrips chained through ``lax.fori_loop`` inside ONE
+jitted program reduced to a scalar; per-iteration time is the median over
+``repeats`` pairs of (t_K - t_1) so the large constant dispatch/readback
+overhead cancels. K must be big enough that (K-1) iterations of work
+dominate the run-to-run noise of that constant (measured at tens of ms on
+the tunnel — K=33-style differences are unusable there, see bench.py).
+A nonpositive median means the work was swamped anyway; callers must treat
+that as a degenerate measurement, not a timing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+import numpy as np
+
+from ..params import FFTNorm
+
+
+def roundtrip_chain(k: int, shape, backend: str):
+    """Jitted scalar-fenced chain of ``k`` R2C+C2R roundtrips of ``shape``
+    (dtype follows the input array: f32 or f64)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import fft as lf
+
+    scale = 1.0 / float(np.prod(shape))
+
+    def body(i, v):
+        c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend)
+        r = lf.irfftn_3d(c, tuple(shape), norm=FFTNorm.NONE, backend=backend)
+        # FFTNorm.NONE leaves both directions unnormalized (cuFFT
+        # convention); rescaling keeps the chained value bounded.
+        return r * scale
+
+    return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
+
+
+def timed_best(fn, x, inner: int) -> float:
+    """Best-of-``inner`` wall-clock of one scalar-fenced call."""
+    best = float("inf")
+    for _ in range(inner):
+        t0 = time.perf_counter()
+        float(fn(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def median_pair_diff_ms(fn1, fnK, x, k: int, repeats: int,
+                        inner: int) -> Tuple[float, float]:
+    """(per-iteration ms from the median (t_K - t_1) pair, last t_1 seconds).
+
+    Callers compile+warm both fns first. The returned t_1 lets a caller
+    build a degenerate fallback (bench.py subtracts a null-readback)."""
+    pairs = [(timed_best(fnK, x, inner), timed_best(fn1, x, inner))
+             for _ in range(repeats)]
+    diffs = sorted(tk - t1 for tk, t1 in pairs)
+    per_ms = diffs[len(diffs) // 2] / (k - 1) * 1e3
+    return per_ms, pairs[-1][1]

@@ -1,0 +1,72 @@
+"""Local-FFT backend autotuner (testing/autotune.py) — the TPU analog of
+cuFFT's plan-time algorithm selection; runs here on the CPU backend."""
+
+import numpy as np
+import pytest
+
+from distributedfft_tpu.ops import mxu_fft
+from distributedfft_tpu.testing import autotune as at
+
+SHAPE = (16, 16, 16)
+
+
+@pytest.fixture(scope="module")
+def ranked():
+    return at.autotune_local_fft(SHAPE, k=33, repeats=2, inner=2)
+
+
+def test_all_candidates_measured(ranked):
+    labels = {c.label for c in ranked}
+    assert {"xla", "matmul@high", "matmul@highest"} <= labels
+    for c in ranked:
+        if c.error is None:
+            assert np.isfinite(c.rel_err)
+
+
+def test_winner_meets_budget_and_sorts_first(ranked):
+    assert ranked[0].ok
+    ok_times = [c.per_iter_ms for c in ranked if c.ok]
+    assert ok_times == sorted(ok_times)
+    # failing/crashed candidates sort after all ok ones
+    flags = [c.ok for c in ranked]
+    assert flags == sorted(flags, reverse=True)
+
+
+def test_apply_best_returns_config(ranked):
+    saved = mxu_fft._PREC_SINGLE
+    try:
+        cfg = at.apply_best(ranked)
+        assert cfg.fft_backend == ranked[0].backend
+    finally:
+        mxu_fft._PREC_SINGLE = saved
+
+
+def test_apply_best_raises_with_diagnosis():
+    ranked = at.autotune_local_fft(SHAPE, budget_rel_err=0.0,
+                                   k=9, repeats=1, inner=1,
+                                   backends=("xla",))
+    assert not ranked[0].ok
+    with pytest.raises(RuntimeError, match="over budget"):
+        at.apply_best(ranked)
+
+
+def test_double_prec_races_single_matmul_candidate():
+    ranked = at.autotune_local_fft(SHAPE, k=9, repeats=1, inner=1,
+                                   backends=("xla", "matmul"),
+                                   double_prec=True)
+    labels = [c.label for c in ranked]
+    assert "matmul" in labels and "matmul@high" not in labels
+    best = ranked[0]
+    assert best.ok and best.rel_err < 1e-10  # f64 path really ran
+
+
+def test_describe_failures_reports_errors_not_budget():
+    cands = [at.Candidate("pallas", None, error="RuntimeError: boom"),
+             at.Candidate("xla", None, rel_err=0.5)]
+    msg = at.describe_failures(cands)
+    assert "boom" in msg and "over budget" in msg
+
+
+def test_precision_global_restored(ranked):
+    # autotune_local_fft must not leave the module precision changed
+    assert mxu_fft._PREC_SINGLE == mxu_fft.lax.Precision.HIGH

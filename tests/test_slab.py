@@ -46,12 +46,15 @@ def test_forward_vs_reference(devices, rng, seq, comm, opt):
 
 @pytest.mark.parametrize("seq", SEQS)
 @pytest.mark.parametrize("comm", COMMS)
-def test_roundtrip_unnormalized(devices, rng, seq, comm):
+@pytest.mark.parametrize("opt", [0, 1])
+def test_roundtrip_unnormalized(devices, rng, seq, comm, opt):
     """Testcase-3 semantics: cuFFT-style unnormalized transforms give
     ifft(fft(x)) == x * Nx*Ny*Nz (reference
-    tests/src/slab/random_dist_default.cu:529-623)."""
+    tests/src/slab/random_dist_default.cu:529-623). opt=1 exercises the
+    realigned (Opt1 coordinate-transform) layout on the inverse path too —
+    the reference needs separate planC2C_inv plans there."""
     g = GlobalSize(16, 16, 16)
-    plan = SlabFFTPlan(g, SlabPartition(8), Config(comm_method=comm),
+    plan = SlabFFTPlan(g, SlabPartition(8), Config(comm_method=comm, opt=opt),
                        sequence=seq)
     x = rng.random(g.shape)
     r = plan.crop_real(plan.exec_c2r(plan.exec_r2c(x)))

@@ -63,7 +63,11 @@ _ROW_BLOCK = 256
 # jnp matmul path.
 _N_MAX = 1024
 
-_PREC = lax.Precision.HIGHEST
+# MXU precision follows the matmul backend's policy (HIGH three-pass bf16
+# for f32 — measured 8.2e-7 fwd rel err at 256^3 — HIGHEST only for f64,
+# which this kernel routes to the fallback anyway). See mxu_fft._PREC_SINGLE.
+def _prec():
+    return mx._prec_for(jnp.float32)
 
 
 def _interpret() -> bool:
@@ -83,7 +87,14 @@ def available() -> bool:
 
 
 def _dot(a, b):
-    return jnp.dot(a, b, precision=_PREC, preferred_element_type=jnp.float32)
+    return jnp.dot(a, b, precision=_prec(), preferred_element_type=jnp.float32)
+
+
+def _c2r_kernel(xr_ref, xi_ref, cr_ref, ci_ref, y_ref):
+    """Half-spectrum inverse: y = Re(c) @ CR - Im(c) @ CI with conjugate
+    symmetry folded into the constant matrices (mxu_fft._c2r_np) — half the
+    MXU work of inverting the Hermitian-extended full spectrum."""
+    y_ref[:] = _dot(xr_ref[:], cr_ref[:]) - _dot(xi_ref[:], ci_ref[:])
 
 
 def _cmatmul_kernel(xr_ref, xi_ref, fr_ref, fi_ref, yr_ref, yi_ref):
@@ -147,6 +158,20 @@ def _f32_planes(F: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
             np.ascontiguousarray(F.imag.astype(np.float32)))
 
 
+def _lift_vma(args, vma):
+    """Under shard_map every kernel operand must carry the same
+    varying-across-mesh-axes set; lift replicated constants to match the
+    per-shard data."""
+    if not vma:
+        return args
+
+    def one(a):
+        missing = vma - getattr(jax.typeof(a), "vma", frozenset())
+        return lax.pvary(a, tuple(missing)) if missing else a
+
+    return [one(a) for a in args]
+
+
 def _call_stage(x2, F_np: np.ndarray, twiddle: "Tuple[int, int, bool] | None"):
     """One DFT stage on 2D data: ``y = (x2 @ F) [* T]``.
 
@@ -166,7 +191,7 @@ def _call_stage(x2, F_np: np.ndarray, twiddle: "Tuple[int, int, bool] | None"):
         F = jnp.asarray(F_np.astype(np.complex64))
         y = (mx._rmatmul_F(x2.astype(jnp.float32), F_np.astype(np.complex64))
              if real_in else jnp.matmul(x2.astype(jnp.complex64), F,
-                                        precision=_PREC))
+                                        precision=_prec()))
         if twiddle is not None:
             n1, n2, inv = twiddle
             tr, ti = _tiled_twiddle(n1, n2, inv, _row_block(n1))
@@ -210,13 +235,7 @@ def _call_stage(x2, F_np: np.ndarray, twiddle: "Tuple[int, int, bool] | None"):
         tr, ti = _tiled_twiddle(n1, n2, inv, tb)
         args += [jnp.asarray(tr), jnp.asarray(ti)]
         specs += [tw_spec, tw_spec]
-    if vma:
-        # Under shard_map every operand of the kernel must carry the same
-        # varying-axes set; lift the replicated constants to match the data.
-        def _lift(a):
-            missing = vma - getattr(jax.typeof(a), "vma", frozenset())
-            return lax.pvary(a, tuple(missing)) if missing else a
-        args = [_lift(a) for a in args]
+    args = _lift_vma(args, vma)
 
     yr, yi = pl.pallas_call(
         kern,
@@ -236,6 +255,43 @@ def _stage(x, F_np: np.ndarray, twiddle=None):
     lead = x.shape[:-1]
     y2 = _call_stage(x.reshape((-1, x.shape[-1])), F_np, twiddle)
     return y2.reshape(lead + (F_np.shape[1],))
+
+
+def _c2r_stage(c, n: int):
+    """Half-spectrum C2R along the last axis (length n//2+1 -> n, real)."""
+    lead = c.shape[:-1]
+    c2 = c.reshape((-1, c.shape[-1])).astype(jnp.complex64)
+    m, n_in = c2.shape
+    CR, CI = mx._c2r_np(n, False)
+    xr, xi = jnp.real(c2), jnp.imag(c2)
+
+    if _interpret() and getattr(jax.typeof(c2), "vma", frozenset()):
+        y2 = (jnp.matmul(xr, jnp.asarray(CR), precision=_prec())
+              - jnp.matmul(xi, jnp.asarray(CI), precision=_prec()))
+        return y2.reshape(lead + (n,))
+
+    tb = _row_block(1)
+    m_pad = tb * ((m + tb - 1) // tb)
+    if m_pad != m:
+        xr = jnp.pad(xr, [(0, m_pad - m), (0, 0)])
+        xi = jnp.pad(xi, [(0, m_pad - m), (0, 0)])
+    vma = getattr(jax.typeof(c2), "vma", frozenset())
+    row_spec = pl.BlockSpec((tb, n_in), lambda i: (i, 0))
+    const_spec = pl.BlockSpec((n_in, n), lambda i: (0, 0))
+    out_spec = pl.BlockSpec((tb, n), lambda i: (i, 0))
+    args = _lift_vma([xr, xi, jnp.asarray(CR), jnp.asarray(CI)], vma)
+    y2 = pl.pallas_call(
+        _c2r_kernel,
+        grid=(m_pad // tb,),
+        in_specs=[row_spec, row_spec, const_spec, const_spec],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), jnp.float32, vma=vma),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * m_pad * n_in * n, transcendentals=0,
+            bytes_accessed=4 * (m_pad * (2 * n_in + n) + 2 * n_in * n)),
+        interpret=_interpret(),
+    )(*args)
+    return (y2[:m] if m_pad != m else y2).reshape(lead + (n,))
 
 
 def _use_fallback(x) -> bool:
@@ -327,8 +383,11 @@ def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE):
     if not mx._is_double(c.dtype):
         c = c.astype(jnp.complex64)
     c = mx._fit_axis(c, -1, n // 2 + 1)
-    full = mx._hermitian_extend(c, n)
-    y = jnp.real(_fft_last(full, True))
+    if _use_fallback(c) or n > mx.DIRECT_MAX:
+        full = mx._hermitian_extend(c, n)
+        y = jnp.real(_fft_last(full, True))
+    else:
+        y = _c2r_stage(c, n)
     return jnp.moveaxis(mx._scaled(y, mx._inv_scale(n, norm)), -1, axis)
 
 

@@ -111,9 +111,17 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
         else:
             cands.append(Candidate(b, None))
 
+    if k < 2:
+        raise ValueError(f"k must be >= 2 (got {k}): the (t_K - t_1) pair "
+                         "difference needs at least one extra iteration")
     saved_prec = mxu_fft._PREC_SINGLE
     try:
         for c in cands:
+            # Matmul variants race at their own precision; every other
+            # backend must race at the DEPLOYED precision (the pre-autotune
+            # global), not whatever the previous candidate left behind —
+            # pallas reads the same global via mxu_fft._prec_for.
+            mxu_fft._PREC_SINGLE = saved_prec
             if c.precision is not None:
                 mxu_fft.set_precision(c.precision)
             try:

@@ -59,6 +59,8 @@ def median_pair_diff_ms(fn1, fnK, x, k: int, repeats: int,
 
     Callers compile+warm both fns first. The returned t_1 lets a caller
     build a degenerate fallback (bench.py subtracts a null-readback)."""
+    if k < 2:
+        raise ValueError(f"k must be >= 2 (got {k})")
     pairs = [(timed_best(fnK, x, inner), timed_best(fn1, x, inner))
              for _ in range(repeats)]
     diffs = sorted(tk - t1 for tk, t1 in pairs)

@@ -70,3 +70,8 @@ def test_describe_failures_reports_errors_not_budget():
 def test_precision_global_restored(ranked):
     # autotune_local_fft must not leave the module precision changed
     assert mxu_fft._PREC_SINGLE == mxu_fft.lax.Precision.HIGH
+
+
+def test_k_below_two_rejected():
+    with pytest.raises(ValueError, match="k must be >= 2"):
+        at.autotune_local_fft(SHAPE, k=1)

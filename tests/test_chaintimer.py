@@ -1,0 +1,38 @@
+"""Shared chained-roundtrip timing harness (testing/chaintimer.py), used by
+bench.py and the autotuner."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributedfft_tpu.testing import chaintimer as ct
+
+
+def test_chain_is_identity_scaled(rng):
+    """One roundtrip through the chain reproduces sum|x| (the chain body is
+    irfftn(rfftn(x))/N^3 == x up to float error)."""
+    shape = (8, 8, 8)
+    x = jax.device_put(rng.random(shape).astype(np.float32))
+    for k in (1, 3):
+        fn = ct.roundtrip_chain(k, shape, "xla")
+        got = float(fn(x))
+        assert got == pytest.approx(float(np.sum(np.abs(np.asarray(x)))),
+                                    rel=1e-4)
+
+
+def test_median_pair_diff_positive_on_real_work(rng):
+    shape = (16, 16, 16)
+    x = jax.device_put(rng.random(shape).astype(np.float32))
+    fn1 = ct.roundtrip_chain(1, shape, "xla")
+    fnK = ct.roundtrip_chain(33, shape, "xla")
+    float(fn1(x))
+    float(fnK(x))
+    per_ms, t1 = ct.median_pair_diff_ms(fn1, fnK, x, 33, repeats=2, inner=2)
+    assert per_ms > 0
+    assert t1 > 0
+
+
+def test_k_guard():
+    with pytest.raises(ValueError, match="k must be >= 2"):
+        ct.median_pair_diff_ms(None, None, None, 1, 1, 1)

@@ -148,3 +148,18 @@ def test_set_precision_accepts_names():
         assert mf._PREC_SINGLE == lax.Precision.HIGH
     finally:
         mf.set_precision(lax.Precision.HIGH)
+
+
+def test_plan_prime_dims_matmul_backend(devices, rng):
+    """Prime global extents (7, 11, 13) under a sharded plan: every axis
+    hits the direct DFT-matmul path and every mesh split needs padding."""
+    g = dfft.GlobalSize(7, 11, 13)
+    plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(4),
+                            dfft.Config(double_prec=True,
+                                        fft_backend="matmul"),
+                            mesh=dfft.make_slab_mesh(4, devices[:4]))
+    x = rng.standard_normal(g.shape)
+    out = plan.crop_spectral(plan.exec_r2c(plan.pad_input(x)))
+    assert _rel(out, np.fft.rfftn(x)) < 1e-10
+    back = plan.crop_real(plan.exec_c2r(plan.exec_r2c(plan.pad_input(x))))
+    assert _rel(back, x * g.n_total) < 1e-10

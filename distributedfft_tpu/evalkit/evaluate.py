@@ -91,22 +91,35 @@ def scan(prefix: str) -> Dict:
     return data
 
 
+FUSED_DESC = "Run complete (fused)"
+
+
 def _run_complete(blocks) -> np.ndarray:
     return np.array([b["Run complete"][0] for b in blocks
                      if "Run complete" in b])
 
 
+def _fused_ms(blocks) -> np.ndarray:
+    """Fused-production-program time per iteration: the FUSED_DESC mark
+    minus the "Run complete" mark (the fused call runs right after the
+    staged pipeline inside the same timer window)."""
+    return np.array([b[FUSED_DESC][0] - b["Run complete"][0] for b in blocks
+                     if FUSED_DESC in b and "Run complete" in b
+                     and b[FUSED_DESC][0] > 0.0])
+
+
 def _phase_durations(blocks) -> Dict[str, float]:
     """Mean per-phase durations from the cumulative timeline markers: each
     stored section's duration is its mark minus the largest earlier mark
-    (sections never stored contribute 0)."""
+    (sections never stored contribute 0). The "Run complete" total and the
+    fused-run marker are not phases."""
     sums: Dict[str, List[float]] = defaultdict(list)
     for b in blocks:
         marks = [(d, v[0]) for d, v in b.items() if v and v[0] > 0.0]
         marks.sort(key=lambda kv: kv[1])
         prev = 0.0
         for desc, mark in marks:
-            if desc == "Run complete":
+            if desc in ("Run complete", FUSED_DESC):
                 continue
             sums[desc].append(mark - prev)
             prev = mark
@@ -153,33 +166,48 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
             os.makedirs(runs_dir, exist_ok=True)
             os.makedirs(sd_dir, exist_ok=True)
             header = ",," + ",".join(all_sizes)
-            runs_lines, sd_lines = [header], [header]
+            runs_lines, sd_lines, fused_lines = [header], [header], [header]
+            have_fused = False
             best_per_size: Dict[str, Tuple[float, Tuple[int, int]]] = {}
             ci_per_size: Dict[str, Tuple[float, float, float]] = {}
             for (comm, snd), sizes in sorted(strategies.items()):
-                means, sds = [], []
+                means, sds, fmeans = [], [], []
                 for s in all_sizes:
                     if s not in sizes:
                         means.append("")
                         sds.append("")
+                        fmeans.append("")
                         continue
                     rc = _run_complete(sizes[s])
                     lo, m, hi = _t_ci(rc)
                     means.append(repr(m))
                     sds.append(repr(float(np.std(rc, ddof=1))
                                     if len(rc) > 1 else 0.0))
+                    fu = _fused_ms(sizes[s])
+                    fmeans.append(repr(float(np.mean(fu))) if len(fu) else "")
+                    have_fused = have_fused or len(fu) > 0
                     if s not in best_per_size or m < best_per_size[s][0]:
                         best_per_size[s] = (m, (comm, snd))
                         ci_per_size[s] = (lo, m, hi)
                 cname, sname = _strategy_names(comm, snd)
                 runs_lines.append(f"{cname},{sname}," + ",".join(means))
                 sd_lines.append(f"{cname},{sname}," + ",".join(sds))
+                fused_lines.append(f"{cname},{sname}," + ",".join(fmeans))
             with open(os.path.join(runs_dir, f"runs_{opt}_{p}_{cuda}.csv"),
                       "w") as f:
                 f.write("\n".join(runs_lines) + "\n")
             with open(os.path.join(sd_dir, f"sd_{opt}_{p}_{cuda}.csv"),
                       "w") as f:
                 f.write("\n".join(sd_lines) + "\n")
+            if have_fused:
+                # The production-path runtimes (one jitted program per
+                # direction); the staged runs_* numbers above attribute
+                # phases but overstate the total (per-stage dispatch +
+                # fences, no cross-stage overlap).
+                with open(os.path.join(runs_dir,
+                                       f"fused_{opt}_{p}_{cuda}.csv"),
+                          "w") as f:
+                    f.write("\n".join(fused_lines) + "\n")
 
             # results triples: best strategy's CI per size
             label = ",".join(filter(None, [*vlabel,

@@ -387,9 +387,12 @@ class PencilFFTPlan(DistFFTPlan):
             return [f"{prefix} Transpose ({x})" for x in xs]
         # 24 sections; only the First transpose has a "(Send Complete)"
         # marker in the reference list.
+        # "Run complete (fused)" extends the vocabulary with the mark after
+        # one extra call of the fused production program (see the slab list).
         return (["init", "1D FFT Z-Direction"] + tr("First", True)
                 + ["1D FFT Y-Direction"] + tr("Second", False)
-                + ["1D FFT X-Direction", "Run complete"])
+                + ["1D FFT X-Direction", "Run complete",
+                   "Run complete (fused)"])
 
     def _xpose_desc(self, which: int) -> str:
         comm = (self.config.comm_method if which == 1

@@ -378,19 +378,24 @@ class SlabFFTPlan(DistFFTPlan):
                  "Transpose (First Receive)", "Transpose (Finished Receive)",
                  "Transpose (Start All2All)", "Transpose (Finished All2All)",
                  "Transpose (Unpacking)"]
+        # "Run complete (fused)" extends the reference vocabulary: the marker
+        # after ONE extra call of the fused production program, so the CSV
+        # carries both staged phase attribution and the true fused runtime
+        # (fused = this mark minus the "Run complete" mark).
         if self.sequence is pm.SlabSequence.ZY_THEN_X:
             # The reference slab_default list carries an extra "2D FFT (Sync)"
             # marker before the 2D FFT row (mpicufft_slab.hpp:209-223).
-            return ["init", "2D FFT (Sync)", first] + xpose + [last,
-                                                               "Run complete"]
+            return ["init", "2D FFT (Sync)", first] + xpose + [
+                last, "Run complete", "Run complete (fused)"]
         if self.sequence is pm.SlabSequence.Y_THEN_ZX:
             # y_then_zx has the short 9-entry list (mpicufft_slab_y_then_zx
             # .hpp:107-109): only P2P phases, no All2All markers.
             return ["init", first, "Transpose (First Send)",
                     "Transpose (Packing)", "Transpose (Start Local Transpose)",
                     "Transpose (Start Receive)", "Transpose (Finished Receive)",
-                    last, "Run complete"]
-        return ["init", first] + xpose + [last, "Run complete"]
+                    last, "Run complete", "Run complete (fused)"]
+        return ["init", first] + xpose + [last, "Run complete",
+                                          "Run complete (fused)"]
 
     def _stage_descs(self) -> Tuple[str, str]:
         return {

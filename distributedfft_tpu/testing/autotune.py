@@ -137,7 +137,11 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
     finally:
         mxu_fft._PREC_SINGLE = saved_prec
 
-    return sorted(cands, key=lambda c: (not c.ok, c.per_iter_ms))
+    # NaN per_iter_ms (crashed before timing) must not poison the sort key:
+    # tuple comparison with NaN gives undefined ordering among failures.
+    return sorted(cands, key=lambda c: (
+        not c.ok,
+        c.per_iter_ms if np.isfinite(c.per_iter_ms) else float("inf")))
 
 
 def describe_failures(candidates: List[Candidate]) -> str:

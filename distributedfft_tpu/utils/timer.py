@@ -103,9 +103,17 @@ class Timer:
             return
         if wrote is False:
             # Native writer failed AFTER opening the file: on-disk state is
-            # unknown, appending a fallback block could duplicate rows.
-            raise OSError(f"native timer CSV append failed for "
-                          f"{self.filename!r}")
+            # unknown, so appending a fallback block could duplicate rows.
+            # Don't abort the (possibly hours-long) sweep over one bad file:
+            # warn, mark the file tainted, and stop writing it — in-memory
+            # durations() remain available to the caller.
+            import warnings
+            warnings.warn(f"native timer CSV append failed for "
+                          f"{self.filename!r}; disabling further CSV output "
+                          f"for this timer (in-memory durations unaffected)",
+                          RuntimeWarning, stacklevel=2)
+            self.filename = None
+            return
         fresh = not os.path.exists(self.filename)
         with open(self.filename, "a") as f:
             if fresh:

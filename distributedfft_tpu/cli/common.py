@@ -19,7 +19,8 @@ import os
 from ..ops.fft import BACKENDS
 
 
-def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
+def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
+                    comm_tunable: bool = False) -> None:
     ap.add_argument("--input-dim-x", "-nx", type=int, required=True,
                     help="size of the input data in x-direction")
     ap.add_argument("--input-dim-y", "-ny", type=int, required=True,
@@ -64,6 +65,17 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
                          "DFFT_NUM_PROCESSES / DFFT_PROCESS_ID or TPU-pod "
                          "autodetection; see parallel/multihost.py and "
                          "jobs/tpu/scripts/). Perf testcases only (0, 2)")
+    if comm_tunable:
+        # Only the decomposition executables run plans; the reference
+        # executable's probes have no comm matrix to tune.
+        ap.add_argument("--autotune-comm", action="store_true",
+                        help="race the comm-strategy matrix (All2All vs "
+                             "Peer2Peer per transpose, x opt 0/1) for this "
+                             "size on the active mesh before running, and "
+                             "use the measured winner — the TPU rendering "
+                             "of the reference's primary comparative "
+                             "dimension (transpose is >=97%% of runtime at "
+                             "scale)")
     if pencil:
         ap.add_argument("--comm-method1", "-comm1", default="Peer2Peer",
                         help='"Peer2Peer" (XLA-scheduled redistribution) or '
@@ -77,6 +89,35 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False) -> None:
     else:
         ap.add_argument("--comm-method", "-comm", default="Peer2Peer")
         ap.add_argument("--send-method", "-snd", default="Sync")
+
+
+def maybe_autotune_comm(args, kind, global_size, partition, cfg,
+                        sequence=None, dims=3):
+    """--autotune-comm: race the comm matrix for this shape on the active
+    mesh, print the measured table, and return the winning Config (the
+    original one when the flag is off). ``dims`` is the pencil partial
+    depth, so the race times the program the run will actually execute."""
+    if not getattr(args, "autotune_comm", False):
+        return cfg
+    if dims < 2:
+        print("autotune-comm: dims=1 performs no transpose; nothing to tune")
+        return cfg
+    from ..testing import autotune as at
+
+    print(f"autotuning comm strategies for {global_size.shape} "
+          f"({kind}, {partition.num_ranks} ranks, dims={dims}):")
+    ranked = at.autotune_comm(kind, global_size, partition, cfg,
+                              sequence=sequence, dims=dims,
+                              iterations=max(args.iterations, 3),
+                              warmup=max(args.warmup_rounds, 1),
+                              verbose=True)
+    best = ranked[0]
+    cfg = at.apply_best_comm(ranked, cfg)
+    runner = ranked[1] if len(ranked) > 1 and ranked[1].ok else None
+    delta = (f", {runner.total_ms - best.total_ms:+.3f} ms vs next "
+             f"({runner.label})" if runner else "")
+    print(f"best: {best.label} ({best.total_ms:.3f} ms roundtrip{delta})")
+    return cfg
 
 
 def maybe_profile(args):

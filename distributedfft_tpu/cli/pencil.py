@@ -13,14 +13,15 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .common import add_common_args, run_testcase, setup_backend
+from .common import (add_common_args, maybe_autotune_comm, run_testcase,
+                     setup_backend)
 
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="pencil", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    add_common_args(ap, pencil=True)
+    add_common_args(ap, pencil=True, comm_tunable=True)
     ap.add_argument("--partition1", "-p1", type=int, required=True,
                     help="partitions in x-direction")
     ap.add_argument("--partition2", "-p2", type=int, required=True,
@@ -49,9 +50,10 @@ def main(argv=None) -> int:
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
         fft_backend=args.fft_backend)
-    plan = tc.make_plan("pencil", g,
-                        pm.PencilPartition(args.partition1, args.partition2),
-                        cfg)
+    part = pm.PencilPartition(args.partition1, args.partition2)
+    cfg = maybe_autotune_comm(args, "pencil", g, part, cfg,
+                              dims=args.fft_dim)
+    plan = tc.make_plan("pencil", g, part, cfg)
     return run_testcase(plan, args, dims=args.fft_dim)
 
 

@@ -2,13 +2,15 @@
 microbenchmarks (reference ``tests/src/reference/main.cpp``,
 ``tests/include/tests_reference.hpp:42-96``).
 
-Testcases:
+Testcases (the reference's 1D/2D/3D-memcpy bandwidth probes, strategy via
+``--opt``: 0 = Peer2Peer/GSPMD resharding, 1 = explicit All2All):
   0: full 3D FFT on one device (the reference's gather -> cufftMakePlan3d
      baseline; in the single-controller model the gather is a device_put).
-  1: redistribution bandwidth, explicit All2All vs GSPMD (Peer2Peer) via
-     ``--opt 0|1``.
-  2: slab-geometry (1D mesh) transpose bandwidth.
-  3: pencil-geometry (2D mesh axis) transpose bandwidth.
+  1: 1D geometry — slab transpose over a 1D mesh.
+  2: 2D geometry — pencil transpose over one axis of a 2D mesh.
+  3: 3D geometry — both non-exchanged axes sharded (strided in two axes).
+Each bandwidth line reports the collectives found in the compiled HLO, so
+a GSPMD 'reshard' that XLA elided would be visible as an empty list.
 """
 
 from __future__ import annotations
@@ -90,15 +92,15 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
     p = len(jax.devices())
     if args.testcase in (1, 2, 3):
         explicit = args.opt != 0  # opt 0: Peer2Peer/GSPMD, opt 1: All2All
-        pencil_axis = args.testcase == 3
+        geometry = {1: "1d", 2: "2d", 3: "3d"}[args.testcase]
         r = mb.transpose_bandwidth(shape, p, explicit=explicit,
                                    iterations=it or 1, warmup=wu,
-                                   dtype=dtype, pencil_axis=pencil_axis)
+                                   dtype=dtype, geometry=geometry)
         kind = "All2All" if explicit else "Peer2Peer(GSPMD)"
-        geom = "pencil-axis" if pencil_axis else "slab"
         print(f"Bandwidth: {r['gb_per_s'] * 1e3:.2f} MB/s "
-              f"[{kind}, {geom}, {p} devices, "
-              f"{r['bytes'] / 1e6:.1f} MB moved in {r['seconds'] * 1e3:.3f} ms]")
+              f"[{kind}, {geometry}, {p} devices, "
+              f"{r['bytes'] / 1e6:.1f} MB moved in {r['seconds'] * 1e3:.3f} ms, "
+              f"collectives={r['collective_ops']}]")
         return 0
     print(f"unknown testcase {args.testcase}", file=sys.stderr)
     return 2

@@ -16,14 +16,15 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .common import add_common_args, run_testcase, setup_backend
+from .common import (add_common_args, maybe_autotune_comm, run_testcase,
+                     setup_backend)
 
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="slab", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    add_common_args(ap, pencil=False)
+    add_common_args(ap, pencil=False, comm_tunable=True)
     ap.add_argument("--sequence", "-s", default="ZY_Then_X",
                     help='"ZY_Then_X" (default), "Z_Then_YX" or "Y_Then_ZX"')
     ap.add_argument("--partitions", "-p", type=int, default=0,
@@ -48,8 +49,10 @@ def main(argv=None) -> int:
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
         fft_backend=args.fft_backend)
-    plan = tc.make_plan("slab", g, pm.SlabPartition(p), cfg,
-                        sequence=args.sequence)
+    part = pm.SlabPartition(p)
+    cfg = maybe_autotune_comm(args, "slab", g, part, cfg,
+                              sequence=args.sequence)
+    plan = tc.make_plan("slab", g, part, cfg, sequence=args.sequence)
     return run_testcase(plan, args)
 
 

@@ -156,6 +156,150 @@ def describe_failures(candidates: List[Candidate]) -> str:
     return "; ".join(parts)
 
 
+@dataclass
+class CommCandidate:
+    """One point of the comm-strategy matrix (the reference's primary
+    comparative dimension, ``include/mpicufft_slab.hpp:145-158``): global-
+    redistribution strategy per transpose x data-layout opt."""
+    comm: object                 # CommMethod for transpose 1
+    comm2: Optional[object]      # pencil transpose 2 (None for slab)
+    opt: int
+    fwd_ms: float = float("nan")
+    inv_ms: float = float("nan")
+    ok: bool = False
+    error: Optional[str] = None
+
+    @property
+    def total_ms(self) -> float:
+        return self.fwd_ms + self.inv_ms
+
+    @property
+    def label(self) -> str:
+        c1 = self.comm.value
+        tag = c1 if self.comm2 is None else f"{c1}+{self.comm2.value}"
+        return f"{tag}/opt{self.opt}"
+
+
+def _time_plan_ms(fn, x, iterations: int, warmup: int) -> float:
+    """Wall-clock one jitted plan program via the shared microbench harness
+    (block_until_ready fence — comm tuning targets real multi-device
+    meshes, where that fence is reliable; the single-chip tunnel has no
+    comm axis to tune)."""
+    from .microbench import _time_fn
+
+    return _time_fn(fn, x, iterations, warmup) * 1e3
+
+
+def autotune_comm(kind: str, global_size, partition, base_config=None,
+                  mesh=None, sequence=None, iterations: int = 5,
+                  warmup: int = 2, race_opt: bool = True, seed: int = 0,
+                  dims: int = 3, verbose: bool = False) -> List[CommCandidate]:
+    """Race the communication strategies for a plan shape ON the active
+    mesh: ALL2ALL (explicit ``lax.all_to_all``) vs PEER2PEER (GSPMD
+    resharding) per transpose, crossed with the opt 0/1 layout axis — at
+    scale the transpose is >=97% of runtime (BASELINE.md), so this axis,
+    not the local-FFT backend, decides the plan. Pencil plans race the
+    2x2 (comm1 x comm2) matrix like the reference's ``-comm1/-comm2``.
+
+    ``dims`` is the pencil partial-transform depth (reference --fft-dim):
+    the race times the SAME program the run will execute — at dims=2 only
+    transpose 1 runs, so comm2 is not raced (it would be noise), and at
+    dims=1 there is no transpose at all (every candidate ties).
+
+    Returns candidates sorted by measured forward+inverse time; apply the
+    winner with ``apply_best_comm``.
+    """
+    import dataclasses as dc
+
+    import numpy as np
+
+    from ..params import CommMethod, Config
+    from . import testcases as tc
+
+    base = base_config or Config()
+    both = (CommMethod.ALL2ALL, CommMethod.PEER2PEER)
+    opts = (0, 1) if race_opt else (base.opt,)
+    race_comm2 = kind == "pencil" and dims >= 3
+    cands: List[CommCandidate] = []
+    for opt in opts:
+        for c1 in both:
+            if race_comm2:
+                cands += [CommCandidate(c1, c2, opt) for c2 in both]
+            else:
+                cands.append(CommCandidate(c1, None, opt))
+
+    rdt = np.float64 if base.double_prec else np.float32
+    xs = np.random.default_rng(seed).random(
+        tuple(global_size.shape)).astype(rdt)
+    for c in cands:
+        try:
+            cfg = dc.replace(base, comm_method=c.comm, comm_method2=c.comm2,
+                             opt=c.opt)
+            plan = tc.make_plan(kind, global_size, partition, cfg,
+                                sequence=sequence, mesh=mesh)
+            x = plan.pad_input(xs)
+            fwd, inv = tc._fused_fns(plan, dims)
+            c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
+            spec = fwd(x)
+            c.inv_ms = _time_plan_ms(inv, spec, iterations, warmup)
+            c.ok = True
+        except Exception as e:  # strategy unavailable for this shape/mesh
+            c.error = f"{type(e).__name__}: {e}"
+        if verbose:
+            print(f"  {c.label:28s} fwd {c.fwd_ms:8.3f} ms  "
+                  f"inv {c.inv_ms:8.3f} ms  ok={c.ok}"
+                  + (f"  ({c.error})" if c.error else ""), flush=True)
+    ranked = sorted(cands, key=lambda c: (
+        not c.ok,
+        c.total_ms if np.isfinite(c.total_ms) else float("inf")))
+
+    import jax
+    if jax.process_count() > 1 and ranked:
+        # Multi-controller runs must AGREE on the winner: candidates are
+        # routinely within noise of each other, and divergent Configs would
+        # build mismatched collective programs across processes (hang).
+        # The candidate list order is deterministic, so broadcasting
+        # process 0's winning index is sufficient agreement. The broadcast
+        # itself is UNCONDITIONAL (sentinel -1 = "nothing ok here"): a
+        # process whose candidates all failed locally must still issue the
+        # same collective as its peers or the agreement step deadlocks.
+        from jax.experimental import multihost_utils
+        idx = (next(i for i, c in enumerate(cands) if c is ranked[0])
+               if ranked[0].ok else -1)
+        idx = int(multihost_utils.broadcast_one_to_all(np.int32(idx)))
+        if idx >= 0:
+            win = cands[idx]
+            ranked.remove(win)
+            ranked.insert(0, win)
+        else:
+            # Process 0 saw no usable strategy: fail identically everywhere
+            # (a per-process mix of success and failure diverges later).
+            for c in ranked:
+                c.ok = False
+                c.error = c.error or "process 0 had no usable strategy"
+    return ranked
+
+
+def apply_best_comm(candidates: List[CommCandidate], base_config=None):
+    """Winning comm matrix folded into a Config. Raises when nothing ran."""
+    import dataclasses as dc
+
+    from ..params import Config
+
+    best = candidates[0]
+    if not best.ok:
+        errs = "; ".join(f"{c.label}: {c.error}" for c in candidates)
+        raise RuntimeError(f"comm autotune: no strategy ran; {errs}")
+    cfg = dc.replace(base_config or Config(), comm_method=best.comm,
+                     opt=best.opt)
+    if best.comm2 is not None:
+        # Only overwrite comm2 when it was actually raced (pencil, dims=3);
+        # otherwise a user's explicit --comm-method2 must survive, or the
+        # benchmark CSVs get filed under a strategy nobody selected.
+        cfg = dc.replace(cfg, comm_method2=best.comm2)
+    return cfg
+
+
 def apply_best(candidates: List[Candidate]):
     """Translate the winning candidate into a ``Config`` (and set the MXU
     precision global when the winner is a matmul variant). Raises when no

@@ -147,14 +147,8 @@ def _child_mesh() -> int:
     n, p = 256, 8
     shape = (n, n, n)
 
-    # Raw probe: the measured all-to-all bandwidth ceiling for this volume.
-    raw = microbench.transpose_bandwidth(shape, p, explicit=True,
-                                         iterations=5, warmup=2)
-    out["alltoall_raw_gb_per_s"] = round(raw["gb_per_s"], 3)
-
     # Pipeline: time the transpose stage of the staged slab forward on the
-    # spectral volume it actually exchanges, then express it as a fraction
-    # of the raw probe (the north star gates on >=70%).
+    # spectral volume it actually exchanges.
     g = dfft.GlobalSize(n, n, n)
     plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(p),
                             dfft.Config(comm_method=dfft.CommMethod.ALL2ALL))
@@ -164,12 +158,21 @@ def _child_mesh() -> int:
     vals, times = [x], {}
     for desc, fn in stages:
         times[desc] = microbench._time_fn(fn, vals[-1], iterations=5,
-                                          warmup=1)
+                                          warmup=2)
         vals.append(fn(vals[-1]))
     xdesc = plan._xpose_desc()
-    xbytes = vals[1].nbytes  # complex spectral volume exchanged
-    pipe_bw = xbytes / times[xdesc] / 1e9
+    spec = vals[1]               # complex spectral volume exchanged
+    pipe_bw = spec.nbytes / times[xdesc] / 1e9
+
+    # Raw probe: the measured all-to-all ceiling for the SAME volume the
+    # pipeline exchanges (shape AND dtype — a mismatched probe once reported
+    # an impossible fraction of 1.67 from accounting + CPU-mesh noise).
+    raw = microbench.transpose_bandwidth(tuple(spec.shape), p, explicit=True,
+                                         iterations=5, warmup=2,
+                                         dtype=np.complex64)
+    out["alltoall_raw_gb_per_s"] = round(raw["gb_per_s"], 3)
     out["pipeline_xpose_gb_per_s"] = round(pipe_bw, 3)
+    # North-star gate: pipeline transpose >= 70% of the raw collective.
     out["alltoall_fraction"] = round(pipe_bw / raw["gb_per_s"], 3)
 
     # CPU fallback roundtrip (used as the headline only if the TPU path is

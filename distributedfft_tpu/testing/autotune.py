@@ -9,9 +9,10 @@ backends (``ops/fft.py``: xla / matmul / pallas, and the matmul backend's MXU
 precision levels) ON THE CURRENT DEVICE, gates candidates on a round-trip
 accuracy budget, and returns the fastest — so ``Config.fft_backend`` can be
 chosen by measurement instead of folklore. Measured v5e example (256^3 f32
-roundtrip): xla 4.89 ms, matmul@HIGHEST 3.19 ms, matmul@HIGH 1.51 ms,
-pallas 5.16 ms — a 3.2x spread that no static default gets right on every
-platform (on CPU, xla wins by a similar margin).
+roundtrip, round 2): xla 4.89 ms, matmul@HIGHEST 2.61 ms, matmul@HIGH
+1.48 ms, pallas (fused two-stage kernels) 3.17 ms — a 3.3x spread that no
+static default gets right on every platform (on CPU, xla wins by a similar
+margin; the pallas negative-result analysis lives in ``ops/pallas_fft.py``).
 
 Timing comes from the shared chained-roundtrip harness
 (``testing/chaintimer.py``, also used by bench.py): median of (t_K - t_1)

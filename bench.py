@@ -100,6 +100,28 @@ def _child_tpu(deadline_s: int) -> int:
             "DFFT_BENCH_SIZES", ",".join(map(str, SIZES))).split(","))
         out["backend"] = backend
         out["platform"] = jax.devices()[0].platform
+
+        # The tunnel has been observed to degrade into a state where any
+        # executable touching complex64 fails with UNIMPLEMENTED (while
+        # pure-f32 programs run fine). Detect it with a tiny complex
+        # program and, if broken, measure via the all-real-planes
+        # formulation — the same DFT matmuls XLA would emit for the
+        # complex program, with no complex dtype anywhere (mxu_fft).
+        if backend == "matmul":
+            try:
+                import jax.numpy as jnp
+                # device_put a real complex operand (the observed failing
+                # op) — a nullary constant expression could be folded at
+                # compile time and probe nothing.
+                cprobe = jax.device_put(
+                    np.ones((8, 8), np.complex64))
+                float(jax.jit(lambda a: jnp.abs(jnp.sum(a)))(cprobe))
+            except TimeoutError:
+                raise  # the child deadline, not a capability signal
+            except Exception:
+                backend = "matmul-planes"
+                out["backend"] = backend
+                out["complex_broken"] = True
         for n in sizes:
             # Smaller cubes need a longer chain for the (K-1) iterations of
             # work to dominate the tunnel's tens-of-ms run-to-run constant

@@ -334,6 +334,68 @@ def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE):
     return fft(c, axis=-3, norm=norm)
 
 
+# ---------------------------------------------------------------------------
+# All-real-planes 3D transform: the same DFT matmuls with the complex
+# arithmetic written out on separate (re, im) f32 planes, so the compiled
+# program contains NO complex dtypes anywhere — input, output, and every
+# intermediate are real. Exists because the axon TPU tunnel has been
+# observed to degrade into a state where any executable touching complex64
+# fails with UNIMPLEMENTED (even device_put); since XLA lowers complex dots
+# to exactly these real matmuls anyway, this formulation measures the same
+# hardware work. Direct sizes only (every axis <= DIRECT_MAX); bench.py
+# falls back to it when its probe finds complex broken.
+# ---------------------------------------------------------------------------
+
+
+_RP_EINSUM = ("ak,ayz->kyz", "ak,xaz->xkz", "ak,xya->xyk")
+
+
+def _rp_stage(ar, ai, F_np: np.ndarray, axis: int):
+    """One DFT stage along ``axis`` of split-plane data. ``ai=None`` means
+    real input (the R2C first stage's two-matmul fast path)."""
+    eq = _RP_EINSUM[axis]
+    prec = _prec_for(ar.dtype)
+    Fr = jnp.asarray(np.ascontiguousarray(F_np.real.astype(np.float32)))
+    Fi = jnp.asarray(np.ascontiguousarray(F_np.imag.astype(np.float32)))
+
+    def e(M, a):
+        return jnp.einsum(eq, M, a, precision=prec)
+
+    if ai is None:
+        return e(Fr, ar), e(Fi, ar)
+    return e(Fr, ar) - e(Fi, ai), e(Fr, ai) + e(Fi, ar)
+
+
+def rfftn_3d_planes(x):
+    """Unnormalized forward R2C over the trailing 3 axes of a REAL 3D f32
+    array, returned as (re, im) f32 planes of shape (X, Y, Z//2+1)."""
+    X, Y, Z = x.shape
+    for n in (X, Y, Z):
+        if n > DIRECT_MAX:
+            raise ValueError(f"rfftn_3d_planes is direct-size only "
+                             f"(axis {n} > {DIRECT_MAX})")
+    ar, ai = _rp_stage(x.astype(jnp.float32), None,
+                       _dft_np(Z, False, False)[:, :Z // 2 + 1], 2)
+    ar, ai = _rp_stage(ar, ai, _dft_np(Y, False, False), 1)
+    return _rp_stage(ar, ai, _dft_np(X, False, False), 0)
+
+
+def irfftn_3d_planes(cr, ci, shape_3d):
+    """Unnormalized inverse of ``rfftn_3d_planes``: (re, im) spectral planes
+    of shape (X, Y, Z//2+1) -> real f32 (X, Y, Z)."""
+    X, Y, Z = shape_3d
+    for n in (X, Y, Z):
+        if n > DIRECT_MAX:
+            raise ValueError(f"irfftn_3d_planes is direct-size only "
+                             f"(axis {n} > {DIRECT_MAX})")
+    er, ei = _rp_stage(cr, ci, _dft_np(X, True, False), 0)
+    er, ei = _rp_stage(er, ei, _dft_np(Y, True, False), 1)
+    CR, CI = _c2r_np(Z, False)
+    prec = _prec_for(er.dtype)
+    return (jnp.einsum(_RP_EINSUM[2], jnp.asarray(CR), er, precision=prec)
+            - jnp.einsum(_RP_EINSUM[2], jnp.asarray(CI), ei, precision=prec))
+
+
 def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE):
     c = ifft(_fit_axis(x, -3, shape_3d[-3]), axis=-3, norm=norm)
     c = ifft(_fit_axis(c, -2, shape_3d[-2]), axis=-2, norm=norm)

@@ -24,21 +24,33 @@ from ..params import FFTNorm
 
 def roundtrip_chain(k: int, shape, backend: str):
     """Jitted scalar-fenced chain of ``k`` R2C+C2R roundtrips of ``shape``
-    (dtype follows the input array: f32 or f64)."""
+    (dtype follows the input array: f32 or f64).
+
+    ``backend="matmul-planes"`` uses the all-real-planes formulation
+    (``mxu_fft.rfftn_3d_planes``): the identical DFT matmuls with no
+    complex dtype anywhere in the program — the bench fallback for a
+    tunnel state where complex executables fail (see mxu_fft)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from ..ops import fft as lf
+    from ..ops import mxu_fft as mx
 
     scale = 1.0 / float(np.prod(shape))
 
-    def body(i, v):
-        c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend)
-        r = lf.irfftn_3d(c, tuple(shape), norm=FFTNorm.NONE, backend=backend)
-        # FFTNorm.NONE leaves both directions unnormalized (cuFFT
-        # convention); rescaling keeps the chained value bounded.
-        return r * scale
+    if backend == "matmul-planes":
+        def body(i, v):
+            cr, ci = mx.rfftn_3d_planes(v)
+            return mx.irfftn_3d_planes(cr, ci, tuple(shape)) * scale
+    else:
+        def body(i, v):
+            c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend)
+            r = lf.irfftn_3d(c, tuple(shape), norm=FFTNorm.NONE,
+                             backend=backend)
+            # FFTNorm.NONE leaves both directions unnormalized (cuFFT
+            # convention); rescaling keeps the chained value bounded.
+            return r * scale
 
     return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
 

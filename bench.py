@@ -179,23 +179,48 @@ def _child_mesh() -> int:
                        .astype(np.float32))
     vals, times = [x], {}
     for desc, fn in stages:
-        times[desc] = microbench._time_fn(fn, vals[-1], iterations=5,
-                                          warmup=2)
+        times[desc] = microbench._time_fn(fn, vals[-1], iterations=10,
+                                          warmup=3)
         vals.append(fn(vals[-1]))
     xdesc = plan._xpose_desc()
     spec = vals[1]               # complex spectral volume exchanged
     pipe_bw = spec.nbytes / times[xdesc] / 1e9
 
-    # Raw probe: the measured all-to-all ceiling for the SAME volume the
-    # pipeline exchanges (shape AND dtype — a mismatched probe once reported
-    # an impossible fraction of 1.67 from accounting + CPU-mesh noise).
-    raw = microbench.transpose_bandwidth(tuple(spec.shape), p, explicit=True,
-                                         iterations=5, warmup=2,
-                                         dtype=np.complex64)
-    out["alltoall_raw_gb_per_s"] = round(raw["gb_per_s"], 3)
+    # Raw probe: the PURE wire exchange of the SAME volume the pipeline
+    # moves (shape AND dtype; all_to_all with no shard-local relayout) —
+    # the true collective ceiling. An earlier relayout-including probe was
+    # consistently BEATEN by the fused pipeline program (fractions
+    # 1.0-1.4), which reads as impossible; against the wire-only ceiling
+    # the fraction is a real efficiency.
+    # Guarded like the geometry matrix: the probe's stricter p^2
+    # divisibility precondition must not discard the pipeline numbers
+    # already in `out`.
     out["pipeline_xpose_gb_per_s"] = round(pipe_bw, 3)
-    # North-star gate: pipeline transpose >= 70% of the raw collective.
-    out["alltoall_fraction"] = round(pipe_bw / raw["gb_per_s"], 3)
+    try:
+        raw = microbench.wire_bandwidth(tuple(spec.shape), p,
+                                        iterations=5, warmup=1,
+                                        dtype=np.complex64, windows=3)
+        out["alltoall_raw_gb_per_s"] = round(raw["gb_per_s"], 3)
+        # North-star gate: pipeline transpose >= 70% of the raw collective.
+        out["alltoall_fraction"] = round(pipe_bw / raw["gb_per_s"], 3)
+    except Exception as e:  # noqa: BLE001 — ceiling probe is optional
+        out["alltoall_raw_error"] = f"{type(e).__name__}: {e}"
+
+    # Geometry attribution matrix (reference testcases 1-3: 1D/2D/3D-memcpy
+    # probes, tests_reference.hpp:53-96): exchange bandwidth per geometry x
+    # strategy, with the collectives found in the compiled HLO as evidence.
+    # Guarded: a failure here must not discard the core metrics above.
+    try:
+        geoms = {}
+        for geom in ("1d", "2d", "3d"):
+            r = microbench.transpose_bandwidth(shape, p, explicit=True,
+                                               iterations=3, warmup=1,
+                                               geometry=geom)
+            geoms[geom] = {"gb_per_s": round(r["gb_per_s"], 3),
+                           "hlo": ",".join(r["collective_ops"])}
+        out["geometry_gb_per_s"] = geoms
+    except Exception as e:  # noqa: BLE001 — optional attribution data
+        out["geometry_error"] = f"{type(e).__name__}: {e}"
 
     # CPU fallback roundtrip (used as the headline only if the TPU path is
     # unreachable; CPU timers are reliable so a short chain suffices).
@@ -315,6 +340,8 @@ def main() -> int:
     if mesh:
         result["alltoall_raw_gb_per_s"] = mesh.get("alltoall_raw_gb_per_s")
         result["alltoall_fraction"] = mesh.get("alltoall_fraction")
+        if mesh.get("geometry_gb_per_s"):
+            result["geometry_gb_per_s"] = mesh["geometry_gb_per_s"]
     if (tpu or {}).get("partial"):
         diags.append(f"tpu partial: {tpu.get('error')}")
     if diags:

@@ -50,6 +50,41 @@ def _collectives_in(compiled) -> list:
     return sorted({op for op in _COLLECTIVE_OPS if op in hlo})
 
 
+def wire_bandwidth(shape, p: int, iterations: int = 10, warmup: int = 2,
+                   dtype=np.float32, windows: int = 1) -> Dict:
+    """PURE all-to-all exchange bandwidth: ``lax.all_to_all`` with
+    ``split_axis == concat_axis``, so the wire transfer happens with no
+    shard-local relayout at all. This is the true collective ceiling the
+    north-star "achieved fraction" gates against — ``transpose_bandwidth``'s
+    probes additionally pay a standalone reshape/concat relayout, which a
+    fused pipeline program can legitimately beat (observed: slab transpose
+    at 1.0-1.4x the relayout probe on the CPU mesh)."""
+    import jax.lax as lax
+
+    mesh = make_slab_mesh(p)
+    spec = PartitionSpec("p", None, None)
+    if shape[0] % (p * p):
+        # The tiled all_to_all re-splits the LOCAL shard axis by p again.
+        raise ValueError(f"wire probe needs shape[0] % {p * p} == 0")
+    x = jax.device_put(np.ones(shape, dtype=dtype),
+                       NamedSharding(mesh, spec))
+    body = jax.shard_map(
+        lambda xl: lax.all_to_all(xl, "p", split_axis=0, concat_axis=0,
+                                  tiled=True),
+        mesh=mesh, in_specs=spec, out_specs=spec)
+    fn = jax.jit(body, in_shardings=NamedSharding(mesh, spec),
+                 out_shardings=NamedSharding(mesh, spec))
+    compiled = fn.lower(x).compile()
+    # A ceiling estimate takes the BEST of ``windows`` timing windows over
+    # the once-compiled program (a noisy window must not drag it down).
+    dt = min(_time_fn(compiled, x, iterations, warmup)
+             for _ in range(max(1, windows)))
+    nbytes = np.prod(shape) * np.dtype(dtype).itemsize
+    return {"seconds": dt, "bytes": int(nbytes),
+            "gb_per_s": nbytes / dt / 1e9,
+            "collective_ops": _collectives_in(compiled)}
+
+
 def transpose_bandwidth(shape, p: int, explicit: bool = True,
                         iterations: int = 10, warmup: int = 2,
                         dtype=np.float32, geometry: str = "1d",

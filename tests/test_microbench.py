@@ -45,3 +45,18 @@ def test_3d_geometry_rejects_degenerate_mesh(devices):
     """p=2 would give p1=1 — the 2d probe mislabeled as 3d."""
     with pytest.raises(ValueError, match="even device count > 2"):
         mb.transpose_bandwidth((16, 16, 16), 2, geometry="3d")
+
+
+def test_wire_bandwidth_pure_exchange(devices):
+    """The wire probe (all_to_all, split==concat axis) runs a real
+    collective with no relayout and reports positive bandwidth + HLO
+    evidence — the ceiling bench.py's alltoall_fraction gates against."""
+    r = mb.wire_bandwidth((64, 16, 16), 8, iterations=1, warmup=0)
+    assert r["gb_per_s"] > 0
+    assert "all-to-all" in r["collective_ops"]
+    assert r["bytes"] == 64 * 16 * 16 * 4
+
+
+def test_wire_bandwidth_rejects_indivisible(devices):
+    with pytest.raises(ValueError, match="wire probe"):
+        mb.wire_bandwidth((16, 16, 16), 8)

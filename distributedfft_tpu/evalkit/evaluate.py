@@ -149,7 +149,9 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
     os.makedirs(out, exist_ok=True)
 
     # union of sizes per (P, cuda) across variants, for results files
-    results_rows: Dict[int, List[Tuple[str, int, List]]] = defaultdict(list)
+    # (label, cuda, (lo/mean/hi value lists), size labels) per variant row
+    results_rows: Dict[int, List[Tuple[str, int, List, List[str]]]] = \
+        defaultdict(list)
     proportions: Dict[Tuple[int, int], List[str]] = defaultdict(list)
 
     for variant, combos in data.items():
@@ -217,7 +219,7 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
                 lo, m, hi = ci_per_size.get(s, (np.nan,) * 3)
                 for i, v in enumerate((lo, m, hi)):
                     triple[i].append(repr(v))
-            results_rows[p].append((label, cuda, triple))
+            results_rows[p].append((label, cuda, triple, all_sizes))
 
             # proportions for the best strategy per size
             prop_lines = [label, "," + ",".join(all_sizes)]
@@ -246,10 +248,10 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
         with open(os.path.join(out, f"proportions_{p}_{cuda}.csv"), "w") as f:
             f.write("\n".join(lines) + "\n")
     for p, rows in results_rows.items():
-        multiple_cuda = len({cuda for _, cuda, _ in rows}) > 1
+        multiple_cuda = len({cuda for _, cuda, _, _ in rows}) > 1
         with open(os.path.join(out, f"results_{p}.csv"), "w") as f:
             f.write(f"TPU P={p}\n")
-            for label, cuda, triple in rows:
+            for label, cuda, triple, _sizes in rows:
                 if multiple_cuda:
                     label = f"{label},cuda{cuda}"
                 for vals in triple:
@@ -267,15 +269,24 @@ def _plot(results_rows, out: str) -> None:
         print("matplotlib unavailable; skipping plots", file=sys.stderr)
         return
     for p, rows in results_rows.items():
+        # Shared categorical size axis: variants with different size sets
+        # must align on actual sizes, not per-row indices.
+        union = sorted({s for _, _, _, sizes in rows for s in sizes},
+                       key=_size_sort_key)
+        pos = {s: i for i, s in enumerate(union)}
         fig, ax = plt.subplots(figsize=(8, 5))
-        for label, cuda, triple in rows:
+        for label, cuda, triple, sizes in rows:
             means = [float(v) if v != "nan" else np.nan for v in triple[1]]
-            ax.plot(range(len(means)), means, marker="o", label=label)
+            ax.plot([pos[s] for s in sizes], means, marker="o", label=label)
         ax.set_yscale("log")
-        ax.set_xlabel("size index")
+        ax.set_xticks(range(len(union)))
+        ax.set_xticklabels([s.replace("_", "×") for s in union],
+                           rotation=30, ha="right", fontsize=7)
+        ax.set_xlabel("global size")
         ax.set_ylabel("Run complete [ms]")
         ax.set_title(f"P={p}")
         ax.legend(fontsize=7)
+        fig.tight_layout()
         fig.savefig(os.path.join(out, f"comparison_{p}.png"), dpi=120)
         plt.close(fig)
 

@@ -249,13 +249,20 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
             f.write("\n".join(lines) + "\n")
     for p, rows in results_rows.items():
         multiple_cuda = len({cuda for _, cuda, _, _ in rows}) > 1
+        # Align every row to the per-P size union (blank cells for sizes a
+        # variant did not run) so column k means the same size in every
+        # row; the header names the columns.
+        union = sorted({s for _, _, _, sizes in rows for s in sizes},
+                       key=_size_sort_key)
         with open(os.path.join(out, f"results_{p}.csv"), "w") as f:
-            f.write(f"TPU P={p}\n")
-            for label, cuda, triple, _sizes in rows:
+            f.write(f"TPU P={p}," + ",".join(union) + "\n")
+            for label, cuda, triple, sizes in rows:
                 if multiple_cuda:
                     label = f"{label},cuda{cuda}"
+                col = {s: i for i, s in enumerate(sizes)}
                 for vals in triple:
-                    f.write(label + "," + ",".join(vals) + "\n")
+                    cells = [vals[col[s]] if s in col else "" for s in union]
+                    f.write(label + "," + ",".join(cells) + "\n")
     if make_plots:
         _plot(results_rows, out)
 

@@ -153,6 +153,8 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
     results_rows: Dict[int, List[Tuple[str, int, List, List[str]]]] = \
         defaultdict(list)
     proportions: Dict[Tuple[int, int], List[str]] = defaultdict(list)
+    # (label, sizes, per-size {phase: share}) per variant
+    prop_plot_data: Dict[Tuple[int, int], List[Tuple]] = defaultdict(list)
 
     for variant, combos in data.items():
         vlabel = _VARIANT_LABELS.get(variant, (variant, ""))
@@ -188,7 +190,11 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
                     fu = _fused_ms(sizes[s])
                     fmeans.append(repr(float(np.mean(fu))) if len(fu) else "")
                     have_fused = have_fused or len(fu) > 0
-                    if s not in best_per_size or m < best_per_size[s][0]:
+                    # A strategy whose blocks carry no "Run complete" mark
+                    # yields NaN; it must never win (NaN < comparisons are
+                    # all False, so once stored it could never be evicted).
+                    if np.isfinite(m) and (s not in best_per_size
+                                           or m < best_per_size[s][0]):
                         best_per_size[s] = (m, (comm, snd))
                         ci_per_size[s] = (lo, m, hi)
                 cname, sname = _strategy_names(comm, snd)
@@ -227,6 +233,10 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
             per_size_props: List[Dict[str, float]] = []
             phases_seen: List[str] = []
             for s in all_sizes:
+                if s not in best_per_size:  # no strategy timed this size
+                    best_names.append("")
+                    per_size_props.append({})
+                    continue
                 _, (comm, snd) = best_per_size[s]
                 cname, sname = _strategy_names(comm, snd)
                 best_names.append(f"{cname}_{sname}")
@@ -243,6 +253,8 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
                 prop_lines.append(d.replace(" ", "_").replace(",", "") + ","
                                   + ",".join(vals))
             proportions[(p, cuda)] += prop_lines + [""]
+            prop_plot_data[(p, cuda)].append(
+                (label, all_sizes, per_size_props))
 
     for (p, cuda), lines in proportions.items():
         with open(os.path.join(out, f"proportions_{p}_{cuda}.csv"), "w") as f:
@@ -265,15 +277,25 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
                     f.write(label + "," + ",".join(cells) + "\n")
     if make_plots:
         _plot(results_rows, out)
+        _plot_proportions(prop_plot_data, out)
 
 
-def _plot(results_rows, out: str) -> None:
+def _pyplot():
+    """Headless pyplot, or None (with a notice) when matplotlib is absent —
+    the shared guard for every plot writer here."""
     try:
         import matplotlib
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
+        return plt
     except ImportError:
         print("matplotlib unavailable; skipping plots", file=sys.stderr)
+        return None
+
+
+def _plot(results_rows, out: str) -> None:
+    plt = _pyplot()
+    if plt is None:
         return
     for p, rows in results_rows.items():
         # Shared categorical size axis: variants with different size sets
@@ -295,6 +317,75 @@ def _plot(results_rows, out: str) -> None:
         ax.legend(fontsize=7)
         fig.tight_layout()
         fig.savefig(os.path.join(out, f"comparison_{p}.png"), dpi=120)
+        plt.close(fig)
+
+
+# Fixed categorical assignment for phase stacks (Okabe-Ito CVD-safe set);
+# phases beyond the palette fold into a neutral "other" — identity is
+# carried by the legend, never by generated hues.
+_PHASE_COLORS = ("#0072B2", "#E69F00", "#009E73", "#CC79A7",
+                 "#56B4E9", "#D55E00", "#F0E442")
+_OTHER_COLOR = "#999999"
+
+
+def _plot_proportions(prop_plot_data, out: str) -> None:
+    """Stacked per-size phase-share bars for the best strategy per size —
+    the visual analog of the reference's proportions plots
+    (``eval/complete/plot_complete.py``). One figure per (P, cuda), one
+    subplot per variant; the phase -> color map is fixed across subplots,
+    with the tail beyond the palette folded into "other"."""
+    plt = _pyplot()
+    if plt is None:
+        return
+    for (p, cuda), variants in prop_plot_data.items():
+        if not variants:
+            continue
+        # Global phase order by mean share, so the palette goes to the
+        # phases that matter and "other" absorbs the long tail.
+        totals: Dict[str, float] = defaultdict(float)
+        for _, _, props in variants:
+            for pr in props:
+                for d, v in pr.items():
+                    totals[d] += v
+        ranked = sorted(totals, key=totals.get, reverse=True)
+        major = ranked[:len(_PHASE_COLORS)]
+        colors = dict(zip(major, _PHASE_COLORS))
+        fig_h = 1.6 + 2.2 * len(variants)
+        fig, axes = plt.subplots(len(variants), 1, squeeze=False,
+                                 figsize=(8, fig_h))
+        for ax, (label, sizes, props) in zip(axes[:, 0], variants):
+            xs = np.arange(len(sizes))
+            bottom = np.zeros(len(sizes))
+            for d in major:
+                vals = np.array([pr.get(d, 0.0) for pr in props])
+                if not vals.any():
+                    continue
+                ax.bar(xs, vals, bottom=bottom, color=colors[d],
+                       edgecolor="white", linewidth=1.0)
+                bottom += vals
+            other = np.array([sum(v for k, v in pr.items()
+                                  if k not in colors) for pr in props])
+            if other.any():
+                ax.bar(xs, other, bottom=bottom, color=_OTHER_COLOR,
+                       edgecolor="white", linewidth=1.0)
+            ax.set_xticks(xs)
+            ax.set_xticklabels([s.replace("_", "×") for s in sizes],
+                               fontsize=7)
+            ax.set_ylabel("share of Run complete", fontsize=7)
+            ax.set_title(label, fontsize=8)
+        # One figure-level legend covering EVERY phase used in any subplot
+        # (a per-axes legend would list only that subplot's phases, leaving
+        # the rest identified by color alone).
+        from matplotlib.patches import Patch
+        handles = [Patch(facecolor=colors[d], label=d) for d in major]
+        handles.append(Patch(facecolor=_OTHER_COLOR, label="other"))
+        fig.legend(handles=handles, fontsize=6, ncol=3, loc="upper center",
+                   bbox_to_anchor=(0.5, 1.0))
+        # tight_layout ignores figure-level legends: reserve ~0.55in of
+        # absolute headroom for the 3-row legend whatever the figure height.
+        fig.tight_layout(rect=(0, 0, 1, max(0.0, 1.0 - 0.55 / fig_h)))
+        fig.savefig(os.path.join(out, f"proportions_{p}_{cuda}.png"),
+                    dpi=120)
         plt.close(fig)
 
 
@@ -372,13 +463,8 @@ def scalability(eval_dir: str, size: str, out_path: "str | None" = None,
         f.write(f"size,{size}\n" + "\n".join(out_lines) + "\n")
 
     if make_plot and series:
-        try:
-            import matplotlib
-            matplotlib.use("Agg")
-            import matplotlib.pyplot as plt
-        except ImportError:
-            print("matplotlib unavailable; skipping scalability plot",
-                  file=sys.stderr)
+        plt = _pyplot()
+        if plt is None:
             return rows
         fig, ax = plt.subplots(figsize=(8, 5))
         multi_cuda = len({c for _, _, c in series}) > 1

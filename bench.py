@@ -130,12 +130,35 @@ def _child_tpu(deadline_s: int) -> int:
             shape = (n, n, n)
             x = jax.device_put(
                 np.random.default_rng(0).random(shape).astype(np.float32))
-            fn1 = chaintimer.roundtrip_chain(1, shape, backend)
-            fnK = chaintimer.roundtrip_chain(k, shape, backend)
-            float(fn1(x))  # compile + warm (scalar readback fences)
-            float(fnK(x))
-            per_ms, t1 = chaintimer.median_pair_diff_ms(
-                fn1, fnK, x, k, repeats=3, inner=3)
+            # Per-size retry: the tunnel's compile path has been observed
+            # to FAIL PER COMPILATION (an executable that compiled well
+            # keeps working; a broken one fails at first use with
+            # UNIMPLEMENTED), so a failed size gets fresh compilations via
+            # clear_caches rather than aborting the whole sweep. Hangs are
+            # the parent timeout's job — only fail-fast errors retry here.
+            last_err = None
+            for attempt in range(3):
+                try:
+                    fn1 = chaintimer.roundtrip_chain(1, shape, backend)
+                    fnK = chaintimer.roundtrip_chain(k, shape, backend)
+                    float(fn1(x))  # compile + warm (scalar readback fences)
+                    float(fnK(x))
+                    per_ms, t1 = chaintimer.median_pair_diff_ms(
+                        fn1, fnK, x, k, repeats=3, inner=3)
+                    last_err = None
+                    break
+                except TimeoutError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — roll a new compile
+                    last_err = e
+                    try:
+                        jax.clear_caches()
+                    except Exception:  # noqa: BLE001 — keep the retry loop
+                        pass
+            if last_err is not None:
+                out["sizes"][str(n)] = {
+                    "error": f"{type(last_err).__name__}: {last_err}"}
+                continue
             rec = {"per_iter_ms": round(per_ms, 4), "k": k}
             if per_ms <= 0:
                 rec["degenerate"] = True

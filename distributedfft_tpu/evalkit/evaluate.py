@@ -22,6 +22,7 @@ Confidence intervals use the Student-t 95% interval like the reference
 from __future__ import annotations
 
 import argparse
+import functools
 import math
 import os
 import re
@@ -280,9 +281,10 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
         _plot_proportions(prop_plot_data, out)
 
 
+@functools.lru_cache(maxsize=1)
 def _pyplot():
-    """Headless pyplot, or None (with a notice) when matplotlib is absent —
-    the shared guard for every plot writer here."""
+    """Headless pyplot, or None (with a one-time notice) when matplotlib is
+    absent — the shared guard for every plot writer here."""
     try:
         import matplotlib
         matplotlib.use("Agg")
@@ -353,6 +355,7 @@ def _plot_proportions(prop_plot_data, out: str) -> None:
         fig_h = 1.6 + 2.2 * len(variants)
         fig, axes = plt.subplots(len(variants), 1, squeeze=False,
                                  figsize=(8, fig_h))
+        drew_other = False
         for ax, (label, sizes, props) in zip(axes[:, 0], variants):
             xs = np.arange(len(sizes))
             bottom = np.zeros(len(sizes))
@@ -366,6 +369,7 @@ def _plot_proportions(prop_plot_data, out: str) -> None:
             other = np.array([sum(v for k, v in pr.items()
                                   if k not in colors) for pr in props])
             if other.any():
+                drew_other = True
                 ax.bar(xs, other, bottom=bottom, color=_OTHER_COLOR,
                        edgecolor="white", linewidth=1.0)
             ax.set_xticks(xs)
@@ -378,7 +382,8 @@ def _plot_proportions(prop_plot_data, out: str) -> None:
         # the rest identified by color alone).
         from matplotlib.patches import Patch
         handles = [Patch(facecolor=colors[d], label=d) for d in major]
-        handles.append(Patch(facecolor=_OTHER_COLOR, label="other"))
+        if drew_other:
+            handles.append(Patch(facecolor=_OTHER_COLOR, label="other"))
         fig.legend(handles=handles, fontsize=6, ncol=3, loc="upper center",
                    bbox_to_anchor=(0.5, 1.0))
         # tight_layout ignores figure-level legends: reserve ~0.55in of

@@ -100,6 +100,20 @@ class TestEvalKit:
         assert d["xpose"] == 3.0
         assert d["last"] == 1.0
 
+    def test_reduce_with_plots_writes_pngs(self, tmp_path):
+        """make_plots emits the comparison and proportions figures (the
+        committed-artifact path); smoke-checks the plot code end-to-end.
+        matplotlib is the optional 'plots' extra, so absent -> skip."""
+        pytest.importorskip("matplotlib")
+        bench = str(tmp_path / "bench")
+        _write_fake_csvs(bench, "slab_default",
+                         [(0, 0, 0), (0, 1, 0)],
+                         [(16, 16, 16), (16, 16, 32)])
+        out = str(tmp_path / "eval")
+        evaluate.reduce_prefix(bench, out, make_plots=True)
+        assert os.path.exists(os.path.join(out, "comparison_8.png"))
+        assert os.path.exists(os.path.join(out, "proportions_8_0.png"))
+
     def test_scalability(self, tmp_path):
         """Perfect 1/P timing must reduce to efficiency ~1 across P."""
         bench = str(tmp_path / "bench")
@@ -146,16 +160,3 @@ class TestProfileDir:
         assert rc == 0
         found = list((tmp_path / "trace").rglob("*.xplane.pb"))
         assert found, "no xplane trace written under --profile-dir"
-
-
-def test_reduce_with_plots_writes_pngs(tmp_path):
-    """make_plots emits the comparison and proportions figures (the
-    committed-artifact path); smoke-checks the plot code end-to-end."""
-    bench = str(tmp_path / "bench")
-    _write_fake_csvs(bench, "slab_default",
-                     [(0, 0, 0), (0, 1, 0)],
-                     [(16, 16, 16), (16, 16, 32)])
-    out = str(tmp_path / "eval")
-    evaluate.reduce_prefix(bench, out, make_plots=True)
-    assert os.path.exists(os.path.join(out, "comparison_8.png"))
-    assert os.path.exists(os.path.join(out, "proportions_8_0.png"))

@@ -87,6 +87,18 @@ def _child_tpu(deadline_s: int) -> int:
 
         import jax
 
+        # Persistent compilation cache: the tunnel's failure mode is
+        # per-compilation, so executables compiled in a healthy window and
+        # cached here let later runs (including the driver's snapshot run)
+        # skip the compile roulette entirely.
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(_REPO, ".jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception:  # noqa: BLE001 — cache is an optimization only
+            pass
+
         if os.environ.get("DFFT_BENCH_FORCE_CPU"):
             # Test hook: exercise this child off-tunnel. The JAX_PLATFORMS
             # env var is clobbered by the axon boot env, so only jax.config
@@ -152,6 +164,14 @@ def _child_tpu(deadline_s: int) -> int:
                 except Exception as e:  # noqa: BLE001 — roll a new compile
                     last_err = e
                     try:
+                        # The persistent cache serializes executables at
+                        # COMPILE time, so a compiled-but-broken one would
+                        # be reloaded verbatim by clear_caches + re-jit
+                        # (and by every later run). Purge it so the retry
+                        # really recompiles; a good compile re-populates.
+                        import shutil
+                        shutil.rmtree(os.path.join(_REPO, ".jax_cache"),
+                                      ignore_errors=True)
                         jax.clear_caches()
                     except Exception:  # noqa: BLE001 — keep the retry loop
                         pass

@@ -280,6 +280,34 @@ def _child_mesh() -> int:
     return 0
 
 
+def _committed_tpu_measurement():
+    """The 256^3 matmul@high row of the committed chain-timed v5e artifact
+    (eval/benchmarks/tpu_v5e), as a clearly-labeled PRIOR measurement for
+    fallback runs. Returns None when the artifact is absent/unparsable."""
+    path = os.path.join(_REPO, "eval", "benchmarks", "tpu_v5e",
+                        "single_chip_chain_timed.csv")
+    try:
+        import csv
+        with open(path, newline="") as f:
+            for cells in csv.reader(f):
+                if (len(cells) >= 7 and cells[0] == "256^3"
+                        and cells[2] == "matmul@high"
+                        and "roundtrip" in cells[1]):
+                    ms = float(cells[3])
+                    return {
+                        "per_iter_ms": ms,
+                        "gflops": float(cells[4]),
+                        "vs_baseline": round(BASELINE_ROUNDTRIP_MS / ms, 3),
+                        "source": cells[6],
+                        "note": ("PRIOR chain-timed single-chip measurement "
+                                 "from the committed artifact, NOT this "
+                                 "run's value"),
+                    }
+    except Exception:  # noqa: BLE001 — absent artifact is fine
+        pass
+    return None
+
+
 # ---------------------------------------------------------------------------
 # parent orchestrator
 # ---------------------------------------------------------------------------
@@ -357,6 +385,7 @@ def main() -> int:
     backend = (tpu or {}).get("backend",
                               os.environ.get("DFFT_BENCH_BACKEND", "matmul"))
     fallback = not (value and not r256.get("degenerate"))
+    result_extra = None
     if not fallback:
         metric = (f"single-chip 256^3 f32 R2C+C2R roundtrip ms on {platform} "
                   f"[{backend} backend] (vs argon single-GPU f64 cufftPlan3d "
@@ -368,6 +397,13 @@ def main() -> int:
                   "backend — TPU path unavailable this run (see diagnostics; "
                   f"baseline {BASELINE_ROUNDTRIP_MS} ms is a GPU number, "
                   "so no cross-platform vs_baseline is reported)")
+        prior = _committed_tpu_measurement()
+        if prior:
+            # Clearly-labeled PRIOR measurement from the committed artifact
+            # (eval/benchmarks/tpu_v5e), so a wedged-tunnel snapshot still
+            # carries the chain-timed chip number next to the live
+            # fallback value.
+            result_extra = prior
     result = {
         "metric": metric,
         "value": value if value is not None else -1.0,
@@ -375,6 +411,8 @@ def main() -> int:
         "vs_baseline": (round(BASELINE_ROUNDTRIP_MS / value, 3)
                         if value and value > 0 and not fallback else None),
     }
+    if result_extra:
+        result["committed_tpu_measurement"] = result_extra
     if sizes:
         result["tpu_sizes"] = sizes
         gf = {k: v["gflops"] for k, v in sizes.items() if "gflops" in v}

@@ -91,7 +91,7 @@ def test_residual_on_random_rhs(devices, rng):
     k = [np.fft.fftfreq(n) * n for n in g.shape[:2]] + \
         [np.arange(g.nz_out, dtype=float)]
     k1, k2, k3 = np.meshgrid(*k, indexing="ij")
-    lap = np.fft.irfftn(-(k1**2 + k2**2 + k3**2) * c, g.shape)
+    lap = np.fft.irfftn(-(k1**2 + k2**2 + k3**2) * c, g.shape, axes=(0, 1, 2))
     np.testing.assert_allclose(lap, f0, atol=1e-9)
 
 

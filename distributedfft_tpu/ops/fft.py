@@ -12,9 +12,12 @@ the untouched axes (cuFFT "batched plan" ≙ XLA treating other axes as batch).
 Every entry point takes ``backend``: ``"xla"`` (default) lowers to XLA's FFT
 expansion; ``"matmul"`` dispatches to the MXU matmul four-step backend
 (``ops/mxu_fft.py``) — the TPU-first alternative that keeps the FLOPs on the
-systolic array; ``"pallas"`` runs the same four-step with hand-written
-Pallas kernels fusing the twiddle epilogue into the DFT matmul
-(``ops/pallas_fft.py``). Selected plan-wide via ``Config.fft_backend``.
+systolic array; ``"matmul-r2"`` is the same backend with radix-2 DIF
+splitting of the C2C stages down to MXU-depth matmuls (measured slower on
+v5e at 256^3 — see ``mxu_fft.set_radix2`` — raced for completeness);
+``"pallas"`` runs the same four-step with hand-written Pallas kernels
+fusing the twiddle epilogue into the DFT matmul (``ops/pallas_fft.py``).
+Selected plan-wide via ``Config.fft_backend``.
 """
 
 from __future__ import annotations
@@ -25,12 +28,33 @@ import jax.numpy as jnp
 
 from ..params import FFTNorm
 
-BACKENDS = ("xla", "matmul", "pallas")
+BACKENDS = ("xla", "matmul", "matmul-r2", "pallas")
 
 
 def _mxu():
     from . import mxu_fft
     return mxu_fft
+
+
+class _MXURadix2:
+    """``"matmul-r2"`` backend: the matmul four-step with radix-2 DIF
+    splitting of the C2C stages down to MXU-depth (128) matmuls
+    (``mxu_fft.set_radix2``). The toggle is trace-time, so this shim flips
+    it around each entry point; everything else (precision policy, norm
+    semantics) is the plain matmul backend."""
+
+    def __getattr__(self, name):
+        mx = _mxu()
+        fn = getattr(mx, name)
+
+        def wrapped(*args, **kwargs):
+            with mx.radix2():
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+_MXU_R2 = _MXURadix2()
 
 
 def _pallas():
@@ -50,6 +74,8 @@ def _impl(backend: str):
     b = validate_backend(backend)
     if b == "matmul":
         return _mxu()
+    if b == "matmul-r2":
+        return _MXU_R2
     if b == "pallas":
         return _pallas()
     return None

@@ -36,6 +36,7 @@ through ``FFTNorm`` exactly like ``ops/fft.py``.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 from typing import Sequence, Tuple
@@ -147,6 +148,71 @@ def set_karatsuba(on: bool) -> None:
     _KARATSUBA = bool(on)
 
 
+# Radix-2 splitting of the C2C stages. A direct depth-n DFT matmul costs
+# O(n) MXU passes per output row-block; decimation-in-frequency recursion
+#
+#   X[2k]   = DFT_{n/2}(x1 + x2)                 (x1 = first half, x2 = second)
+#   X[2k+1] = DFT_{n/2}((x1 - x2) * w^j),  w = exp(-+2*pi*i/n)
+#
+# halves the matmul depth per level at the cost of one VPU butterfly and an
+# even/odd output interleave. Recursing down to depth _R2_BASE = 128 — the
+# MXU's native contraction depth, below which passes waste systolic rows —
+# turns the depth-256 stages of a 256^3 transform into two depth-128
+# matmuls plus cheap elementwise work: ~2x fewer MXU passes on the stages
+# that dominate the roundtrip. Measured on v5e at 256^3 f32 it is a net
+# LOSS (2.64 ms roundtrip vs 1.52 ms direct, same session): the interleave
+# store is a full-array relayout per stage that XLA does NOT fold away, and
+# like the Karatsuba toggle above, trading MXU passes for extra HBM traffic
+# loses on an op that is already bandwidth-balanced. Kept as a raced
+# backend ("matmul-r2") because the trade-off flips where compute dominates
+# (deeper axes / cheaper memory systems); both input halves are contiguous
+# (DIF, not DIT), so no strided gather on the input side.
+_RADIX2 = False
+_R2_BASE = 128
+
+
+def set_radix2(on: bool) -> None:
+    """Toggle radix-2 DIF splitting of C2C stages down to depth-128
+    matmuls (trace-time flag, like ``set_precision``)."""
+    global _RADIX2
+    _RADIX2 = bool(on)
+
+
+@contextlib.contextmanager
+def radix2(on: bool = True):
+    """Scoped ``set_radix2``: restores the previous flag on exit (the
+    "matmul-r2" backend shim and tests wrap trace-time calls in this)."""
+    saved = _RADIX2
+    set_radix2(on)
+    try:
+        yield
+    finally:
+        set_radix2(saved)
+
+
+@functools.lru_cache(maxsize=None)
+def _r2_twiddle_np(n: int, inverse: bool, double: bool) -> np.ndarray:
+    """Radix-2 DIF twiddle w^j = exp(-+2*pi*i*j/n), j in [0, n/2)."""
+    dt = np.complex128 if double else np.complex64
+    sign = 2j if inverse else -2j
+    return np.exp(sign * np.pi * np.arange(n // 2) / n).astype(dt)
+
+
+def _fft_radix2(x, inverse: bool):
+    """DIF radix-2 split of an even-length last-axis DFT: two half-length
+    DFTs (recursively down to ``_R2_BASE``) + butterfly + interleave."""
+    n = x.shape[-1]
+    h = n // 2
+    dbl = _is_double(x.dtype)
+    x1 = x[..., :h]
+    x2 = x[..., h:]
+    even = _fft_last(x1 + x2, inverse)
+    odd = _fft_last((x1 - x2) * jnp.asarray(_r2_twiddle_np(n, inverse, dbl)),
+                    inverse)
+    # X[2k] = even[k], X[2k+1] = odd[k]
+    return jnp.stack([even, odd], axis=-1).reshape(x.shape[:-1] + (n,))
+
+
 def _matmul_F(x, F_np: np.ndarray):
     """x @ F for complex x and a constant complex DFT matrix."""
     prec = _prec_for(x.dtype)
@@ -178,6 +244,8 @@ def _fft_last(x, inverse: bool):
     """Unnormalized DFT along the last axis of a complex array."""
     n = x.shape[-1]
     dbl = _is_double(x.dtype)
+    if _RADIX2 and n > _R2_BASE and n % 2 == 0:
+        return _fft_radix2(x, inverse)
     if n <= DIRECT_MAX:
         return _matmul_F(x, _dft_np(n, inverse, dbl))
     n1, n2 = _split(n)

@@ -255,10 +255,11 @@ class Config:
     collectives are always on for TPU.
     ``fft_backend`` selects the local-transform implementation: ``"xla"``
     (XLA's FFT expansion), ``"matmul"`` (MXU four-step DFT matmuls,
-    ``ops/mxu_fft.py``), or ``"pallas"`` (Pallas kernels fusing the
-    four-step twiddle into the DFT matmul, ``ops/pallas_fft.py``) — the TPU
-    analog of the reference's cuFFT-plan choice at L0
-    (``include/cufft.hpp:23-61``).
+    ``ops/mxu_fft.py``), ``"matmul-r2"`` (same with radix-2 DIF splitting
+    down to MXU-depth matmuls, ``mxu_fft.set_radix2``), or ``"pallas"``
+    (Pallas kernels fusing the four-step twiddle into the DFT matmul,
+    ``ops/pallas_fft.py``) — the TPU analog of the reference's cuFFT-plan
+    choice at L0 (``include/cufft.hpp:23-61``).
     """
 
     comm_method: CommMethod = CommMethod.ALL2ALL

@@ -10,9 +10,10 @@ precision levels) ON THE CURRENT DEVICE, gates candidates on a round-trip
 accuracy budget, and returns the fastest — so ``Config.fft_backend`` can be
 chosen by measurement instead of folklore. Measured v5e example (256^3 f32
 roundtrip, round 2): xla 4.89 ms, matmul@HIGHEST 2.61 ms, matmul@HIGH
-1.48 ms, pallas (fused two-stage kernels) 3.17 ms — a 3.3x spread that no
-static default gets right on every platform (on CPU, xla wins by a similar
-margin; the pallas negative-result analysis lives in ``ops/pallas_fft.py``).
+1.48 ms, matmul-r2@HIGH 2.64 ms, pallas (fused two-stage kernels) 3.17 ms —
+a 3.3x spread that no static default gets right on every platform (on CPU,
+xla wins by a similar margin; the pallas negative-result analysis lives in
+``ops/pallas_fft.py``, the radix-2 one at ``mxu_fft.set_radix2``).
 
 Timing comes from the shared chained-roundtrip harness
 (``testing/chaintimer.py``, also used by bench.py): median of (t_K - t_1)
@@ -106,9 +107,8 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
 
     cands: List[Candidate] = []
     for b in backends:
-        if b == "matmul" and not double_prec:
-            cands += [Candidate("matmul", "high"),
-                      Candidate("matmul", "highest")]
+        if b in ("matmul", "matmul-r2") and not double_prec:
+            cands += [Candidate(b, "high"), Candidate(b, "highest")]
         else:
             cands.append(Candidate(b, None))
 

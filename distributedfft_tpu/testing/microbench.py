@@ -174,7 +174,8 @@ def single_device_fft_ms(shape, iterations: int = 10, warmup: int = 2,
     """Reference testcase 0 analog: full 3D FFT of ``shape = (nx, ny, nz)``
     on one device (the cufftMakePlan3d baseline curve). Input is staged on
     device once. ``backend`` selects the local transform implementation
-    (``ops/fft.py`` ``BACKENDS``: "xla", "matmul", or "pallas")."""
+    (``ops/fft.py`` ``BACKENDS``: "xla", "matmul", "matmul-r2", or
+    "pallas")."""
     from ..ops import fft as lf
 
     lf.validate_backend(backend)

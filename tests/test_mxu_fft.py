@@ -202,3 +202,77 @@ def test_real_planes_chain_backend(rng):
     a = float(chaintimer.roundtrip_chain(2, (8, 8, 8), "matmul")(x))
     b = float(chaintimer.roundtrip_chain(2, (8, 8, 8), "matmul-planes")(x))
     assert abs(a - b) / abs(a) < 1e-4
+
+
+class TestRadix2:
+    """Radix-2 DIF splitting (``set_radix2`` / backend "matmul-r2"):
+    halves MXU matmul depth on C2C stages down to the 128-deep base case."""
+
+    @pytest.mark.parametrize("n", [160, 256, 512])
+    @pytest.mark.parametrize("double", [False, True])
+    def test_fft_vs_numpy(self, n, double, rng):
+        dt = np.complex128 if double else np.complex64
+        tol = 1e-10 if double else 5e-4
+        x = (rng.standard_normal((3, n)) + 1j * rng.standard_normal((3, n))
+             ).astype(dt)
+        with mxu_fft.radix2():
+            got = np.asarray(mxu_fft.fft(x, axis=-1))
+            goti = np.asarray(mxu_fft.ifft(x, axis=-1))
+        assert _rel(got, np.fft.fft(x, axis=-1)) < tol
+        assert _rel(goti, n * np.fft.ifft(x, axis=-1)) < tol
+
+    def test_backend_shim_restores_flag(self, rng):
+        """The "matmul-r2" backend flips the trace-time flag only for the
+        duration of the call."""
+        assert mxu_fft._RADIX2 is False
+        x = rng.random((256, 4, 4)).astype(np.float32)
+        c = lf.rfftn_3d(x, backend="matmul-r2")
+        assert mxu_fft._RADIX2 is False
+        ref = np.fft.rfftn(x, axes=(0, 1, 2))
+        assert _rel(np.asarray(c), ref) < 5e-4
+        y = lf.irfftn_3d(c, x.shape, backend="matmul-r2")
+        assert _rel(np.asarray(y) / x.size, x) < 5e-4
+
+    def test_roundtrip_f64_tight(self, rng):
+        """f64 radix-2 roundtrip at the north-star accuracy bar."""
+        x = rng.standard_normal((256, 6, 6))
+        c = lf.rfftn_3d(x, backend="matmul-r2")
+        y = np.asarray(lf.irfftn_3d(c, x.shape, backend="matmul-r2")) / x.size
+        assert np.abs(y - x).max() < 1e-10
+
+    def test_odd_length_unaffected(self, rng):
+        """Odd n can't split: radix-2 toggle must leave it identical to the
+        direct path."""
+        x = (rng.standard_normal((3, 81)) + 1j * rng.standard_normal((3, 81))
+             ).astype(np.complex128)
+        base = np.asarray(mxu_fft.fft(x, axis=-1))
+        with mxu_fft.radix2():
+            r2 = np.asarray(mxu_fft.fft(x, axis=-1))
+        np.testing.assert_array_equal(base, r2)
+
+    def test_autotune_races_r2(self, devices):
+        """matmul-r2 shows up in the autotune candidate list with both
+        precision variants."""
+        from distributedfft_tpu.testing import autotune
+
+        # 160 on the last axis: above _R2_BASE=128, so the r2 candidates
+        # really trace the split path, not the shared direct fallback.
+        cands = autotune.autotune_local_fft(
+            (8, 8, 160), backends=("matmul", "matmul-r2"), k=3,
+            repeats=1, inner=1)
+        labels = {c.label for c in cands}
+        assert {"matmul@high", "matmul@highest", "matmul-r2@high",
+                "matmul-r2@highest"} <= labels
+        assert all(c.ok for c in cands), [c.error for c in cands]
+
+    def test_plan_backend_r2(self, devices, rng):
+        """End-to-end sharded slab plan with Config(fft_backend='matmul-r2').
+        x = 160 > _R2_BASE so the 1D-FFT(x) stage really takes the radix-2
+        split, not the shared direct fallback."""
+        g = dfft.GlobalSize(160, 16, 16)
+        plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(8),
+                                dfft.Config(double_prec=True,
+                                            fft_backend="matmul-r2"))
+        x = rng.standard_normal(g.shape)
+        out = plan.crop_spectral(plan.exec_r2c(x))
+        assert _rel(out, np.fft.rfftn(x)) < 1e-10

@@ -50,9 +50,12 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _flops_roundtrip(n: int) -> float:
-    """R2C + C2R flops: 2.5·N^3·log2(N^3) per direction (BASELINE.md)."""
-    import math
-    return 2 * 2.5 * n**3 * math.log2(float(n) ** 3)
+    """R2C + C2R flops (BASELINE.md §Derived). Delegates to the shared
+    FLOP model; imported lazily because only the CHILD processes may
+    import the package (it pulls in jax, and the parent must stay
+    jax-free — see the module docstring)."""
+    from distributedfft_tpu.testing.workloads import flops_roundtrip_3d
+    return flops_roundtrip_3d(n)
 
 
 # ---------------------------------------------------------------------------
@@ -87,18 +90,6 @@ def _child_tpu(deadline_s: int) -> int:
 
         import jax
 
-        # Persistent compilation cache: the tunnel's failure mode is
-        # per-compilation, so executables compiled in a healthy window and
-        # cached here let later runs (including the driver's snapshot run)
-        # skip the compile roulette entirely.
-        try:
-            jax.config.update("jax_compilation_cache_dir",
-                              os.path.join(_REPO, ".jax_cache"))
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.0)
-        except Exception:  # noqa: BLE001 — cache is an optimization only
-            pass
-
         if os.environ.get("DFFT_BENCH_FORCE_CPU"):
             # Test hook: exercise this child off-tunnel. The JAX_PLATFORMS
             # env var is clobbered by the axon boot env, so only jax.config
@@ -113,27 +104,46 @@ def _child_tpu(deadline_s: int) -> int:
         out["backend"] = backend
         out["platform"] = jax.devices()[0].platform
 
-        # The tunnel has been observed to degrade into a state where any
-        # executable touching complex64 fails with UNIMPLEMENTED (while
-        # pure-f32 programs run fine). Detect it with a tiny complex
-        # program and, if broken, measure via the all-real-planes
-        # formulation — the same DFT matmuls XLA would emit for the
-        # complex program, with no complex dtype anywhere (mxu_fft).
+        # The tunnel has been observed to degrade into a state where
+        # executables touching complex64 fail with UNIMPLEMENTED (while
+        # pure-f32 programs run fine). Detect it with a tiny
+        # complex-INTERMEDIATE program — real input, complex arithmetic
+        # inside, real scalar out, exactly the dtype profile of the matmul
+        # measurement chains. Never jax.device_put a complex array through
+        # the tunnel: the complex TRANSFER itself has been observed to
+        # poison the whole session (every subsequent compile in the
+        # process fails UNIMPLEMENTED, even pure-f32 ones — 11 consecutive
+        # bench children died this way on 2026-07-30 while
+        # f32-first-touch processes ran the same programs fine).
         if backend == "matmul":
             try:
                 import jax.numpy as jnp
-                # device_put a real complex operand (the observed failing
-                # op) — a nullary constant expression could be folded at
-                # compile time and probe nothing.
-                cprobe = jax.device_put(
-                    np.ones((8, 8), np.complex64))
-                float(jax.jit(lambda a: jnp.abs(jnp.sum(a)))(cprobe))
+                from jax import lax as jlax
+                rp = jax.device_put(np.ones((8, 8), np.float32))
+                float(jax.jit(lambda v: jnp.abs(jnp.sum(
+                    jlax.complex(v, v) * jlax.complex(v, -v))))(rp))
             except TimeoutError:
                 raise  # the child deadline, not a capability signal
             except Exception:
                 backend = "matmul-planes"
                 out["backend"] = backend
                 out["complex_broken"] = True
+
+        # Persistent compilation cache: the tunnel's failure mode is
+        # per-compilation, so executables compiled in a healthy window and
+        # cached here let later runs (including the driver's snapshot run)
+        # skip the compile roulette entirely. Enabled only AFTER the
+        # capability probe above, which must compile fresh every run — a
+        # cache-hit probe would validate a broken-complex session against
+        # an executable serialized in a healthy one.
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(_REPO, ".jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception:  # noqa: BLE001 — cache is an optimization only
+            pass
+
         for size_idx, n in enumerate(sizes):
             # Smaller cubes need a longer chain for the (K-1) iterations of
             # work to dominate the tunnel's tens-of-ms run-to-run constant

@@ -1,0 +1,59 @@
+"""Chained workload timers (testing/workloads.py): the BASELINE application
+configs measured with the chaintimer methodology."""
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu.testing import workloads
+
+
+def test_poisson_chain_converges_and_is_bounded(devices):
+    """k=1 equals one plain solve; a longer chain stays bounded (the
+    fixed-point argument in the module docstring)."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    fn1, plan = workloads.poisson_chain(1, 16, backend="xla")
+    x = rng.random(plan.global_size.shape).astype(np.float32)
+    xp = plan.pad_input(x)
+    s1 = float(fn1(xp))
+
+    from distributedfft_tpu.solvers.poisson import PoissonSolver
+    solver = PoissonSolver(plan, mode="integer")
+    ref = float(jax.numpy.sum(jax.numpy.abs(solver.solve(xp + xp))))
+    assert s1 == pytest.approx(ref, rel=1e-5)
+
+    fn64, _ = workloads.poisson_chain(64, 16, backend="xla")
+    s64 = float(fn64(xp))
+    assert np.isfinite(s64)
+    assert s64 < 1e6  # bounded, no blow-up over 64 iterations
+
+
+def test_poisson_chain_sharded(devices):
+    """The chain composes with a real 8-device slab plan."""
+    rng = np.random.default_rng(1)
+    fn, plan = workloads.poisson_chain(
+        4, 16, backend="xla", partition=dfft.SlabPartition(8))
+    x = plan.pad_input(rng.random((16, 16, 16)).astype(np.float32))
+    assert np.isfinite(float(fn(x)))
+
+
+def test_batched2d_chain_matches_identity(devices):
+    """One forward+inverse roundtrip with the 1/(nx*ny) rescale is the
+    identity, so sum|chain(x)| == sum|x| for any k."""
+    rng = np.random.default_rng(2)
+    fn, plan = workloads.batched2d_chain(3, 4, 16, 16, backend="xla")
+    x = rng.random((4, 16, 16)).astype(np.float32)
+    xp = plan.pad_input(x)
+    assert float(fn(xp)) == pytest.approx(float(np.abs(xp).sum()), rel=1e-4)
+
+
+def test_flops_formulas():
+    """Independently derived values: 128^3 = 2097152 elements,
+    log2(128^3) = 21 exactly, so 5 * 2097152 * 21 = 220200960; the
+    batched-2D stack has 64 * 4096^2 elements with log2(4096^2) = 24,
+    so 5 * 64 * 16777216 * 24 = 128849018880."""
+    assert workloads.flops_poisson(128) == 220200960.0
+    assert workloads.flops_roundtrip_3d(128) == 220200960.0
+    assert workloads.flops_batched2d(64, 4096, 4096) == 128849018880.0

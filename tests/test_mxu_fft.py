@@ -276,3 +276,27 @@ class TestRadix2:
         x = rng.standard_normal(g.shape)
         out = plan.crop_spectral(plan.exec_r2c(x))
         assert _rel(out, np.fft.rfftn(x)) < 1e-10
+
+
+class TestFourStepEinsum:
+    """Relayout-free four-step formulation (``set_fourstep_einsum``): same
+    math as the swapaxes pipeline, contracted via dot_general. Measured
+    slower on v5e (see the module comment) — kept as a raced toggle."""
+
+    @pytest.mark.parametrize("n", [640, 1024, 2048])
+    def test_c2c_matches_swap_path(self, n, rng):
+        x = (rng.standard_normal((3, n)) + 1j * rng.standard_normal((3, n)))
+        base = np.asarray(mxu_fft.fft(x, axis=-1))
+        with mxu_fft.fourstep_einsum():
+            via_einsum = np.asarray(mxu_fft.fft(x, axis=-1))
+        # Same factor matrices and contraction math; tolerance instead of
+        # bit-equality because the two dot_general lowerings may differ in
+        # accumulation order across jaxlib versions.
+        assert _rel(via_einsum, base) < 1e-14
+
+    def test_r2c_vs_numpy(self, rng):
+        x = rng.standard_normal((4, 640))
+        with mxu_fft.fourstep_einsum():
+            got = np.asarray(mxu_fft.rfft(x, axis=-1))
+        ref = np.fft.rfft(x, axis=-1)
+        assert _rel(got, ref) < 1e-10

@@ -58,7 +58,7 @@ def poisson_chain(k: int, n: int, backend: str = "matmul",
 def batched2d_chain(k: int, batch: int, nx: int, ny: int,
                     backend: str = "matmul",
                     partition: pm.SlabPartition | None = None, mesh=None,
-                    shard: str = "batch"):
+                    shard: str = "batch", batch_chunk=None):
     """Jitted scalar-fenced chain of ``k`` batched-2D R2C+C2R roundtrips.
 
     Returns ``fn(x)`` for a (padded) ``(batch, nx, ny)`` f32 stack.
@@ -69,7 +69,7 @@ def batched2d_chain(k: int, batch: int, nx: int, ny: int,
 
     plan = Batched2DFFTPlan(batch, nx, ny, partition or pm.SlabPartition(1),
                             pm.Config(fft_backend=backend), mesh=mesh,
-                            shard=shard)
+                            shard=shard, batch_chunk=batch_chunk)
     scale = 1.0 / float(nx * ny)
 
     def fn(x):

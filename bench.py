@@ -247,34 +247,50 @@ def _child_mesh() -> int:
     stages = plan.forward_stages()
     x = plan.pad_input(np.random.default_rng(0).random(g.shape)
                        .astype(np.float32))
-    vals, times = [x], {}
-    for desc, fn in stages:
-        times[desc] = microbench._time_fn(fn, vals[-1], iterations=10,
-                                          warmup=3)
-        vals.append(fn(vals[-1]))
+    vals = [x]
+    xpose_fn = None
     xdesc = plan._xpose_desc()
+    for desc, fn in stages:
+        if desc == xdesc:
+            xpose_fn = (fn, vals[-1])
+        vals.append(fn(vals[-1]))
     spec = vals[1]               # complex spectral volume exchanged
-    pipe_bw = spec.nbytes / times[xdesc] / 1e9
 
     # Raw probe: the PURE wire exchange of the SAME volume the pipeline
     # moves (shape AND dtype; all_to_all with no shard-local relayout) —
     # the true collective ceiling. An earlier relayout-including probe was
     # consistently BEATEN by the fused pipeline program (fractions
     # 1.0-1.4), which reads as impossible; against the wire-only ceiling
-    # the fraction is a real efficiency.
-    # Guarded like the geometry matrix: the probe's stricter p^2
-    # divisibility precondition must not discard the pipeline numbers
-    # already in `out`.
-    out["pipeline_xpose_gb_per_s"] = round(pipe_bw, 3)
-    try:
-        raw = microbench.wire_bandwidth(tuple(spec.shape), p,
-                                        iterations=5, warmup=1,
-                                        dtype=np.complex64, windows=3)
-        out["alltoall_raw_gb_per_s"] = round(raw["gb_per_s"], 3)
-        # North-star gate: pipeline transpose >= 70% of the raw collective.
-        out["alltoall_fraction"] = round(pipe_bw / raw["gb_per_s"], 3)
+    # the fraction is a real efficiency. Pipeline and raw are measured in
+    # INTERLEAVED windows with a per-metric best-of: on a loaded host a
+    # single window of either can land in a congested slice and produce
+    # fractions from 0.5 to 1.4 run-to-run; best-of-each compares the two
+    # at their respective least-disturbed moments.
+    # Guarded like the geometry matrix: the raw probe's stricter p^2
+    # divisibility precondition must not discard the pipeline numbers.
+    raw_window = None
+    try:  # compile the wire probe ONCE; each window only re-times it
+        raw_window, raw_info = microbench.wire_probe(
+            tuple(spec.shape), p, dtype=np.complex64)
     except Exception as e:  # noqa: BLE001 — ceiling probe is optional
         out["alltoall_raw_error"] = f"{type(e).__name__}: {e}"
+    pipe_bw, raw_bw = 0.0, None
+    for _ in range(3):
+        fn, arg = xpose_fn
+        t = microbench._time_fn(fn, arg, iterations=5, warmup=1)
+        pipe_bw = max(pipe_bw, spec.nbytes / t / 1e9)
+        if raw_window is not None:
+            try:
+                dt = raw_window(iterations=5, warmup=1)
+                raw_bw = max(raw_bw or 0.0, raw_info["bytes"] / dt / 1e9)
+            except Exception as e:  # noqa: BLE001 — keep pipeline windows
+                out["alltoall_raw_error"] = f"{type(e).__name__}: {e}"
+                raw_window = None
+    out["pipeline_xpose_gb_per_s"] = round(pipe_bw, 3)
+    if raw_bw:
+        out["alltoall_raw_gb_per_s"] = round(raw_bw, 3)
+        # North-star gate: pipeline transpose >= 70% of the raw collective.
+        out["alltoall_fraction"] = round(pipe_bw / raw_bw, 3)
 
     # Geometry attribution matrix (reference testcases 1-3: 1D/2D/3D-memcpy
     # probes, tests_reference.hpp:53-96): exchange bandwidth per geometry x

@@ -50,15 +50,13 @@ def _collectives_in(compiled) -> list:
     return sorted({op for op in _COLLECTIVE_OPS if op in hlo})
 
 
-def wire_bandwidth(shape, p: int, iterations: int = 10, warmup: int = 2,
-                   dtype=np.float32, windows: int = 1) -> Dict:
-    """PURE all-to-all exchange bandwidth: ``lax.all_to_all`` with
-    ``split_axis == concat_axis``, so the wire transfer happens with no
-    shard-local relayout at all. This is the true collective ceiling the
-    north-star "achieved fraction" gates against — ``transpose_bandwidth``'s
-    probes additionally pay a standalone reshape/concat relayout, which a
-    fused pipeline program can legitimately beat (observed: slab transpose
-    at 1.0-1.4x the relayout probe on the CPU mesh)."""
+def wire_probe(shape, p: int, dtype=np.float32):
+    """Build + compile the PURE all-to-all exchange once; returns
+    ``(time_window, info)`` where ``time_window(iterations, warmup)`` times
+    one window of the compiled program (seconds) and ``info`` carries the
+    exchanged bytes and the HLO collective evidence. Lets callers interleave
+    repeated windows with other measurements without recompiling
+    (``bench.py`` mesh child)."""
     import jax.lax as lax
 
     mesh = make_slab_mesh(p)
@@ -75,14 +73,29 @@ def wire_bandwidth(shape, p: int, iterations: int = 10, warmup: int = 2,
     fn = jax.jit(body, in_shardings=NamedSharding(mesh, spec),
                  out_shardings=NamedSharding(mesh, spec))
     compiled = fn.lower(x).compile()
+    nbytes = int(np.prod(shape) * np.dtype(dtype).itemsize)
+    info = {"bytes": nbytes, "collective_ops": _collectives_in(compiled)}
+
+    def time_window(iterations: int = 10, warmup: int = 2) -> float:
+        return _time_fn(compiled, x, iterations, warmup)
+
+    return time_window, info
+
+
+def wire_bandwidth(shape, p: int, iterations: int = 10, warmup: int = 2,
+                   dtype=np.float32, windows: int = 1) -> Dict:
+    """PURE all-to-all exchange bandwidth: ``lax.all_to_all`` with
+    ``split_axis == concat_axis``, so the wire transfer happens with no
+    shard-local relayout at all. This is the true collective ceiling the
+    north-star "achieved fraction" gates against — ``transpose_bandwidth``'s
+    probes additionally pay a standalone reshape/concat relayout, which a
+    fused pipeline program can legitimately beat (observed: slab transpose
+    at 1.0-1.4x the relayout probe on the CPU mesh)."""
+    time_window, info = wire_probe(shape, p, dtype=dtype)
     # A ceiling estimate takes the BEST of ``windows`` timing windows over
     # the once-compiled program (a noisy window must not drag it down).
-    dt = min(_time_fn(compiled, x, iterations, warmup)
-             for _ in range(max(1, windows)))
-    nbytes = np.prod(shape) * np.dtype(dtype).itemsize
-    return {"seconds": dt, "bytes": int(nbytes),
-            "gb_per_s": nbytes / dt / 1e9,
-            "collective_ops": _collectives_in(compiled)}
+    dt = min(time_window(iterations, warmup) for _ in range(max(1, windows)))
+    return {"seconds": dt, **info, "gb_per_s": info["bytes"] / dt / 1e9}
 
 
 def transpose_bandwidth(shape, p: int, explicit: bool = True,

@@ -263,7 +263,15 @@ class TestRadix2:
         labels = {c.label for c in cands}
         assert {"matmul@high", "matmul@highest", "matmul-r2@high",
                 "matmul-r2@highest"} <= labels
-        assert all(c.ok for c in cands), [c.error for c in cands]
+        # The test pins dispatch + accuracy, not wall-clock: on a loaded
+        # host a k=3 chain of a 16^3-ish problem can legitimately measure
+        # degenerate (median t_K - t_1 <= 0), which is not a failure of
+        # the r2 path — but accuracy (computed before timing) must hold
+        # even then.
+        for c in cands:
+            assert c.ok or (c.error and "degenerate" in c.error
+                            and c.rel_err <= 1e-4), \
+                (c.label, c.error, c.rel_err)
 
     def test_plan_backend_r2(self, devices, rng):
         """End-to-end sharded slab plan with Config(fft_backend='matmul-r2').

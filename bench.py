@@ -65,6 +65,10 @@ def _flops_roundtrip(n: int) -> float:
 def _child_probe() -> int:
     """Claim the default platform, touch one device, exit cleanly."""
     import jax
+    if os.environ.get("DFFT_BENCH_FORCE_CPU"):
+        # Test hook (same as the tpu child's): lets the WHOLE parent
+        # pipeline run off-tunnel so CI can exercise the orchestration.
+        jax.config.update("jax_platforms", "cpu")
     d = jax.devices()
     x = jax.device_put(1.0)
     print(json.dumps({"platform": d[0].platform, "n": len(d),
@@ -236,7 +240,9 @@ def _child_mesh() -> int:
     from distributedfft_tpu.testing import chaintimer, microbench
 
     out = {}
-    n, p = 256, 8
+    # DFFT_BENCH_MESH_N: test hook shrinking the mesh-child volume so the
+    # full parent pipeline is runnable in CI time (default = BASELINE 256).
+    n, p = int(os.environ.get("DFFT_BENCH_MESH_N", "256")), 8
     shape = (n, n, n)
 
     # Pipeline: time the transpose stage of the staged slab forward on the
@@ -318,7 +324,8 @@ def _child_mesh() -> int:
     float(fn5(x1))
     per_ms, _ = chaintimer.median_pair_diff_ms(fn1, fn5, x1, 5,
                                                repeats=2, inner=1)
-    out["cpu_roundtrip_ms_256"] = round(per_ms, 3)
+    out["cpu_roundtrip_ms"] = round(per_ms, 3)
+    out["cpu_roundtrip_n"] = n
     print(json.dumps(out))
     return 0
 
@@ -349,6 +356,15 @@ def _committed_tpu_measurement():
     except Exception:  # noqa: BLE001 — absent artifact is fine
         pass
     return None
+
+
+def _headline_size() -> str:
+    """The size the scoreboard compares against: 256 when requested (the
+    BASELINE comparison size), else the largest requested size."""
+    req = os.environ.get("DFFT_BENCH_SIZES",
+                         ",".join(map(str, SIZES))).split(",")
+    vals = [int(s) for s in req if s.strip()]
+    return "256" if 256 in vals else str(max(vals))
 
 
 # ---------------------------------------------------------------------------
@@ -445,9 +461,12 @@ def main() -> int:
                     t["sizes"] = merged
                     tpu = t
             # Degenerate timings (median t_K - t_1 <= 0) don't count: step 4
-            # would discard them, so they must not suppress the retry.
-            good = any(_measured(r)
-                       for r in (tpu or {}).get("sizes", {}).values())
+            # would discard them, so they must not suppress the retry. And
+            # the retry gates on the HEADLINE size: a run where 128^3
+            # measured but 256^3 hit a bad session must still burn a fresh
+            # process on the size the scoreboard compares against.
+            cur = (tpu or {}).get("sizes", {})
+            good = _measured(cur.get(_headline_size(), {}))
             if good:
                 break
             msg = f"tpu attempt {proc_attempt + 1}: no size measured"
@@ -455,26 +474,39 @@ def main() -> int:
                 msg += "; retrying in a fresh process"
             diags.append(msg)
 
-    # 4. Assemble the one JSON line.
+    # 4. Assemble the one JSON line. Headline = the 256^3 measurement
+    #    (the BASELINE comparison size); if sizes were overridden and 256
+    #    is absent, the largest measured size still headlines (with no
+    #    cross-size vs_baseline) instead of pretending the chip failed.
     sizes = (tpu or {}).get("sizes", {})
-    r256 = sizes.get("256", {})
-    value = r256.get("per_iter_ms")
+    measured = {s: r for s, r in sizes.items()
+                if "per_iter_ms" in r and not r.get("degenerate")}
+    pick = "256" if "256" in measured else (
+        max(measured, key=int) if measured else None)
+    value = measured[pick]["per_iter_ms"] if pick else None
     platform = (tpu or {}).get("platform", "?")
     backend = (tpu or {}).get("backend",
                               os.environ.get("DFFT_BENCH_BACKEND", "matmul"))
-    fallback = not (value and not r256.get("degenerate"))
+    fallback = pick is None
     result_extra = None
     if not fallback:
-        metric = (f"single-chip 256^3 f32 R2C+C2R roundtrip ms on {platform} "
-                  f"[{backend} backend] (vs argon single-GPU f64 cufftPlan3d "
-                  f"{BASELINE_ROUNDTRIP_MS} ms; vs_baseline = baseline/ours, "
-                  f">1 is faster)")
+        vs = (f"(vs argon single-GPU f64 cufftPlan3d {BASELINE_ROUNDTRIP_MS} "
+              "ms; vs_baseline = baseline/ours, >1 is faster)"
+              if pick == "256" else
+              "(baseline is a 256^3 number, so no vs_baseline at this size)")
+        metric = (f"single-chip {pick}^3 f32 R2C+C2R roundtrip ms on "
+                  f"{platform} [{backend} backend] {vs}")
+        if pick != "256":
+            # A non-256 headline (256 failed or wasn't requested) still
+            # carries the committed 256^3 chip number for the comparison.
+            result_extra = _committed_tpu_measurement()
     else:
-        value = (mesh or {}).get("cpu_roundtrip_ms_256")
-        metric = ("CPU-FALLBACK 256^3 f32 R2C+C2R roundtrip ms on the CPU "
-                  "backend — TPU path unavailable this run (see diagnostics; "
-                  f"baseline {BASELINE_ROUNDTRIP_MS} ms is a GPU number, "
-                  "so no cross-platform vs_baseline is reported)")
+        value = (mesh or {}).get("cpu_roundtrip_ms")
+        cpu_n = (mesh or {}).get("cpu_roundtrip_n", 256)
+        metric = (f"CPU-FALLBACK {cpu_n}^3 f32 R2C+C2R roundtrip ms on the "
+                  "CPU backend — TPU path unavailable this run (see "
+                  f"diagnostics; baseline {BASELINE_ROUNDTRIP_MS} ms is a "
+                  "GPU number, so no cross-platform vs_baseline is reported)")
         prior = _committed_tpu_measurement()
         if prior:
             # Clearly-labeled PRIOR measurement from the committed artifact
@@ -487,7 +519,8 @@ def main() -> int:
         "value": value if value is not None else -1.0,
         "unit": "ms",
         "vs_baseline": (round(BASELINE_ROUNDTRIP_MS / value, 3)
-                        if value and value > 0 and not fallback else None),
+                        if value and value > 0 and not fallback
+                        and pick == "256" else None),
     }
     if result_extra:
         result["committed_tpu_measurement"] = result_extra

@@ -88,23 +88,73 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_two_procs(tmp_path, script_text):
+    """Launch the worker script as a 2-process multi-controller job and
+    return both outputs. Kills both processes on timeout — a regression
+    that deadlocks a collective must not leave orphans holding the
+    coordinator port."""
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    port = _free_port()
+    procs = []
+    try:
+        for i in range(2):
+            env = dict(os.environ,
+                       PYTHONPATH=os.path.dirname(os.path.dirname(__file__)),
+                       DFFT_COORDINATOR=f"localhost:{port}",
+                       DFFT_NUM_PROCESSES="2", DFFT_PROCESS_ID=str(i))
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen([sys.executable, str(script)],
+                                          env=env, stdout=subprocess.PIPE,
+                                          stderr=subprocess.STDOUT,
+                                          text=True))
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    return outs
+
+
 def test_two_process_mesh_end_to_end(tmp_path):
     """Two controllers x 4 CPU devices: rendezvous, per-process input
     blocks, and the slab pipeline's all_to_all crossing processes."""
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    port = _free_port()
-    procs = []
-    for i in range(2):
-        env = dict(os.environ,
-                   PYTHONPATH=os.path.dirname(os.path.dirname(__file__)),
-                   DFFT_COORDINATOR=f"localhost:{port}",
-                   DFFT_NUM_PROCESSES="2", DFFT_PROCESS_ID=str(i))
-        env.pop("XLA_FLAGS", None)
-        procs.append(subprocess.Popen([sys.executable, str(script)],
-                                      env=env, stdout=subprocess.PIPE,
-                                      stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=300)[0] for p in procs]
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    outs = _run_two_procs(tmp_path, _WORKER)
+    for i, out in enumerate(outs):
         assert f"OK {i}/2" in out
+
+
+_AUTOTUNE_WORKER = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    from distributedfft_tpu.parallel import multihost as mh
+    pid, cnt = mh.maybe_initialize()
+    assert cnt == 2, (pid, cnt)
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu.testing import autotune
+    g = dfft.GlobalSize(16, 16, 16)
+    cands = autotune.autotune_comm("slab", g, dfft.SlabPartition(8),
+                                   iterations=1, warmup=0)
+    win = cands[0]
+    assert win.ok, autotune.describe_failures(cands)
+    print(f"WINNER {pid} {win.label}", flush=True)
+    mh.shutdown()
+""")
+
+
+def test_two_process_comm_autotune_agreement(tmp_path):
+    """The comm-strategy autotuner's multi-controller agreement step: both
+    processes must run the same unconditional broadcast (a divergent
+    collective deadlocks) and emerge with the SAME winner, regardless of
+    per-process timing noise."""
+    outs = _run_two_procs(tmp_path, _AUTOTUNE_WORKER)
+    winners = []
+    for i, out in enumerate(outs):
+        line = [ln for ln in out.splitlines() if ln.startswith(f"WINNER {i} ")]
+        assert line, out
+        winners.append(line[0].split(maxsplit=2)[2])
+    assert winners[0] == winners[1], winners

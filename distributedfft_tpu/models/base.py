@@ -27,6 +27,26 @@ from ..ops import fft as local_fft
 from ..params import Config, FFTNorm, GlobalSize, Partition
 
 
+def _with_pad(pure, logical_shape, padded_shape):
+    """Wrap a pure pipeline so logical-shaped input is zero-padded to the
+    mesh-divisible padded shape (the traced analog of the exec_* padding
+    preamble; ``jnp.pad``'s vjp slices the cotangent, so the wrapper stays
+    differentiable). Padded-shaped input passes through untouched."""
+    logical = tuple(logical_shape)
+    padded = tuple(padded_shape)
+    if logical == padded:
+        return pure
+
+    import jax.numpy as jnp
+
+    def fn(x):
+        if tuple(x.shape) == logical:
+            x = jnp.pad(x, [(0, p - s) for p, s in zip(padded, logical)])
+        return pure(x)
+
+    return fn
+
+
 class DistFFTPlan:
     """Base class for slab / pencil plans.
 
@@ -48,6 +68,8 @@ class DistFFTPlan:
         self.fft3d = mesh is None or partition.num_ranks == 1
         self._r2c = None
         self._c2r = None
+        self._fwd_pure = None
+        self._inv_pure = None
         self._in_spec: Optional[PartitionSpec] = None
         self._out_spec: Optional[PartitionSpec] = None
 
@@ -112,26 +134,45 @@ class DistFFTPlan:
     def _build_c2r(self):
         raise NotImplementedError
 
+    # -- pure pipelines (compose under user transforms) --------------------
+
+    def forward_fn(self):
+        """The PURE forward pipeline: the same composition `exec_r2c` jits,
+        but with no ``jax.jit`` wrapper and no input/output sharding
+        annotations, so it composes under USER transforms — ``jax.grad``
+        through the distributed spectral pipeline (all_to_all transposes
+        included), an enclosing ``jax.jit``, etc. A capability the
+        reference's hand-rolled MPI exchanges cannot express. The sharded
+        collectives differentiate cleanly; the local transform's vjp is
+        backend-dependent (``fft_backend="matmul"`` — pure einsum — is the
+        differentiable TPU-native choice; XLA's FFT op may lack a transpose
+        rule under shard_map). See tests/test_autodiff.py."""
+        raise NotImplementedError
+
+    def inverse_fn(self):
+        """Pure inverse pipeline (see ``forward_fn``)."""
+        raise NotImplementedError
+
     # -- single-device fallback ------------------------------------------
 
-    def _fft3d_r2c(self):
+    def _fft3d_r2c(self, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
 
         def run(x):
             return local_fft.rfftn_3d(x, norm=norm, backend=be)
 
-        return jax.jit(run)
+        return jax.jit(run) if jit else run
 
-    def _fft3d_c2r(self):
+    def _fft3d_c2r(self, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
         shape = self.input_shape
 
         def run(c):
             return local_fft.irfftn_3d(c, shape, norm=norm, backend=be)
 
-        return jax.jit(run)
+        return jax.jit(run) if jit else run
 
-    def _fft3d_c2c(self, forward: bool):
+    def _fft3d_c2c(self, forward: bool, jit: bool = True):
         """Single-device full 3D C2C (both directions unnormalized under
         FFTNorm.NONE, like cuFFT's CUFFT_FORWARD/CUFFT_INVERSE)."""
         norm, be = self.config.norm, self.config.fft_backend
@@ -142,7 +183,7 @@ class DistFFTPlan:
                 return local_fft.fftn(c, axes, norm=norm, backend=be)
             return local_fft.ifftn(c, axes, norm=norm, backend=be)
 
-        return jax.jit(run)
+        return jax.jit(run) if jit else run
 
     # -- staged-execution helper (shared by slab/pencil) -------------------
 

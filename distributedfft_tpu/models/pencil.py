@@ -53,7 +53,7 @@ from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
 from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
-from .base import DistFFTPlan
+from .base import DistFFTPlan, _with_pad
 
 P1_AXIS, P2_AXIS = PENCIL_AXES
 
@@ -339,9 +339,8 @@ class PencilFFTPlan(DistFFTPlan):
 
     # -- pipeline builders -------------------------------------------------
 
-    def _build_r2c_d(self, dims: int):
-        if self.fft3d:
-            return self._fft3d_r2c_d(dims)
+    def _fwd_segments(self, dims: int):
+        """(segments, start_spec) of the forward pipeline."""
         s1, t1, s2, t2, s3 = self._fwd_parts(dims)
         segments = [(s1, self._in_spec)]
         if dims >= 2:
@@ -351,11 +350,10 @@ class PencilFFTPlan(DistFFTPlan):
             self._append(segments, self.config.resolved_comm2(), t2,
                          self._out_spec)
             segments.append((s3, self._out_spec))
-        return self._compile(segments, self._in_spec)
+        return segments, self._in_spec
 
-    def _build_c2r_d(self, dims: int):
-        if self.fft3d:
-            return self._fft3d_c2r_d(dims)
+    def _inv_segments(self, dims: int):
+        """(segments, start_spec) of the inverse pipeline."""
         i3, t2b, i2, t1b, i1 = self._inv_parts(dims)
         segments: List = []
         if dims >= 3:
@@ -367,7 +365,50 @@ class PencilFFTPlan(DistFFTPlan):
             self._append(segments, self.config.comm_method, t1b, self._in_spec)
         segments.append((i1, self._in_spec))
         start = {3: self._out_spec, 2: self._mid_spec, 1: self._in_spec}[dims]
-        return self._compile(segments, start)
+        return segments, start
+
+    def _build_r2c_d(self, dims: int):
+        if self.fft3d:
+            return self._fft3d_r2c_d(dims)
+        return self._compile(*self._fwd_segments(dims))
+
+    def _build_c2r_d(self, dims: int):
+        if self.fft3d:
+            return self._fft3d_c2r_d(dims)
+        return self._compile(*self._inv_segments(dims))
+
+    def forward_fn(self, dims: int = 3):
+        """Pure forward pipeline (``DistFFTPlan.forward_fn`` contract);
+        ``dims`` as in ``exec_r2c``. Cached per (plan, dims); pads
+        logical-shaped input like the exec path (traced, differentiable)."""
+        if self._fwd_pure is None:
+            self._fwd_pure = {}
+        if dims not in self._fwd_pure:
+            if self.fft3d:
+                run = self._fft3d_r2c_d(dims, jit=False)
+            else:
+                run, _ = self._compose(*self._fwd_segments(dims))
+            self._fwd_pure[dims] = _with_pad(run, self.input_shape,
+                                             self.input_padded_shape)
+        return self._fwd_pure[dims]
+
+    def inverse_fn(self, dims: int = 3):
+        """Pure inverse pipeline (``DistFFTPlan.forward_fn`` contract).
+        At dims=3 logical-shaped spectral input is padded like the exec
+        path; partial-depth (dims<3) input must already be in the padded
+        intermediate layout ``output_padded_shape_for(dims)``."""
+        if self._inv_pure is None:
+            self._inv_pure = {}
+        if dims not in self._inv_pure:
+            if self.fft3d:
+                run = self._fft3d_c2r_d(dims, jit=False)
+            else:
+                run, _ = self._compose(*self._inv_segments(dims))
+            if dims == 3:
+                run = _with_pad(run, self.output_shape,
+                                self.output_padded_shape)
+            self._inv_pure[dims] = run
+        return self._inv_pure[dims]
 
     # -- per-phase staged execution (benchmark timer support) --------------
 
@@ -444,9 +485,9 @@ class PencilFFTPlan(DistFFTPlan):
         else:
             segments.append(("BREAK", spec_after))
 
-    def _compile(self, segments, in_spec):
+    def _compose(self, segments, in_spec):
         """Fuse consecutive segments that share a shard_map into staged
-        shard_maps; jit the composition with in/out shardings."""
+        shard_maps; returns the pure composition and its out spec."""
         mesh = self.mesh
         stages = []
         cur_fns: List = []
@@ -482,14 +523,19 @@ class PencilFFTPlan(DistFFTPlan):
                 x = st(x)
             return x
 
-        out_spec = segments[-1][1]
+        return run, segments[-1][1]
+
+    def _compile(self, segments, in_spec):
+        """Jit the pure composition with in/out shardings."""
+        run, out_spec = self._compose(segments, in_spec)
+        mesh = self.mesh
         return jax.jit(run,
                        in_shardings=NamedSharding(mesh, in_spec),
                        out_shardings=NamedSharding(mesh, out_spec))
 
     # -- single-device partial-dim fallbacks ------------------------------
 
-    def _fft3d_r2c_d(self, dims: int):
+    def _fft3d_r2c_d(self, dims: int, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
         complex_mode = self.transform == "c2c"
 
@@ -504,9 +550,9 @@ class PencilFFTPlan(DistFFTPlan):
                 c = lf.fft(c, axis=0, norm=norm, backend=be)
             return c
 
-        return jax.jit(run)
+        return jax.jit(run) if jit else run
 
-    def _fft3d_c2r_d(self, dims: int):
+    def _fft3d_c2r_d(self, dims: int, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
         nz = self.global_size.nz
         complex_mode = self.transform == "c2c"
@@ -520,5 +566,5 @@ class PencilFFTPlan(DistFFTPlan):
                 return lf.ifft(c, axis=2, norm=norm, backend=be)
             return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be)
 
-        return jax.jit(run)
+        return jax.jit(run) if jit else run
 

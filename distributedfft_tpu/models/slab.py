@@ -60,7 +60,7 @@ from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
-from .base import DistFFTPlan
+from .base import DistFFTPlan, _with_pad
 
 
 @dataclasses.dataclass(frozen=True)
@@ -333,7 +333,15 @@ class SlabFFTPlan(DistFFTPlan):
                               self.config.comm_method)
 
     def _assemble(self, parts, in_spec, out_spec, comm: pm.CommMethod):
-        """Compose (first, xpose, last) into one jitted program.
+        """Compose (first, xpose, last) into one jitted program (the pure
+        composition from ``_assemble_pure`` with in/out shardings)."""
+        pure = self._assemble_pure(parts, in_spec, out_spec, comm)
+        mesh = self.mesh
+        return jax.jit(pure, in_shardings=NamedSharding(mesh, in_spec),
+                       out_shardings=NamedSharding(mesh, out_spec))
+
+    def _assemble_pure(self, parts, in_spec, out_spec, comm: pm.CommMethod):
+        """Compose (first, xpose, last) into one pure callable.
 
         ALL2ALL: a single shard_map containing the explicit collective.
         PEER2PEER: two shard_map stages with the transpose omitted — the
@@ -343,18 +351,47 @@ class SlabFFTPlan(DistFFTPlan):
         engine)."""
         first, xpose, last = parts
         mesh = self.mesh
-        in_ns = NamedSharding(mesh, in_spec)
-        out_ns = NamedSharding(mesh, out_spec)
         if comm is pm.CommMethod.ALL2ALL:
-            fused = jax.shard_map(lambda xl: last(xpose(first(xl))), mesh=mesh,
-                                  in_specs=in_spec, out_specs=out_spec)
-            return jax.jit(fused, in_shardings=in_ns, out_shardings=out_ns)
+            return jax.shard_map(lambda xl: last(xpose(first(xl))), mesh=mesh,
+                                 in_specs=in_spec, out_specs=out_spec)
         stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
                                out_specs=in_spec)
         stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
                                out_specs=out_spec)
-        return jax.jit(lambda x: stage2(stage1(x)),
-                       in_shardings=in_ns, out_shardings=out_ns)
+        return lambda x: stage2(stage1(x))
+
+    def forward_fn(self):
+        """Pure forward pipeline (``DistFFTPlan.forward_fn`` contract).
+        Cached per plan (a fresh closure per call would defeat the caller's
+        jit cache); pads logical-shaped input like ``exec_r2c`` does, with
+        a traced ``jnp.pad`` so the preamble stays differentiable."""
+        if self._fwd_pure is None:
+            if self.fft3d:
+                pure = (self._fft3d_c2c(forward=True, jit=False)
+                        if self.transform == "c2c"
+                        else self._fft3d_r2c(jit=False))
+            else:
+                pure = self._assemble_pure(self._fwd_parts(), self._in_spec,
+                                           self._out_spec,
+                                           self.config.comm_method)
+            self._fwd_pure = _with_pad(pure, self.input_shape,
+                                       self.input_padded_shape)
+        return self._fwd_pure
+
+    def inverse_fn(self):
+        """Pure inverse pipeline (``DistFFTPlan.forward_fn`` contract)."""
+        if self._inv_pure is None:
+            if self.fft3d:
+                pure = (self._fft3d_c2c(forward=False, jit=False)
+                        if self.transform == "c2c"
+                        else self._fft3d_c2r(jit=False))
+            else:
+                pure = self._assemble_pure(self._inv_parts(), self._out_spec,
+                                           self._in_spec,
+                                           self.config.comm_method)
+            self._inv_pure = _with_pad(pure, self.output_shape,
+                                       self.output_padded_shape)
+        return self._inv_pure
 
     # -- per-phase staged execution (benchmark timer support) --------------
 

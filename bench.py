@@ -12,10 +12,12 @@ eating the whole 480 s deadline with nothing emitted; see
   raw all-to-all transpose bandwidth on the 8-device mesh, the pipeline's
   achieved fraction of it (the ">=70% of measured all-to-all bandwidth"
   north-star number), and a CPU fallback roundtrip timing.
-* Child 2 (``--child probe``) is a pre-flight TPU claim with a short parent
-  timeout. Only if it exits cleanly does the real measurement run; on
-  failure the parent cools down once and re-probes (a killed claim wedges
-  the tunnel for a while — SKILL.md).
+* Child 2 (``--child probe``) is ONE generous pre-flight TPU claim (a
+  wedged claim can clear if the process waits, while every kill restarts
+  the 10-15 min wedge clock — SKILL.md). Only if it exits cleanly does
+  the real measurement run; a clean fast failure earns one immediate
+  re-probe, a killed probe does not, and the probe is skipped entirely
+  when no budget would remain for the measurement anyway.
 * Child 3 (``--child tpu``) times the single-chip R2C+C2R roundtrip at
   128^3 and 256^3 with the shared chained-roundtrip harness
   (distributedfft_tpu/testing/chaintimer.py: scalar-fenced jitted fori_loop
@@ -41,8 +43,7 @@ import time
 
 BASELINE_ROUNDTRIP_MS = 4.4  # 2 x 2.20 ms (argon single-GPU 256^3 inverse)
 BUDGET_S = 450               # parent wall-clock; driver's outer limit is >480
-PROBE_TIMEOUT_S = 90
-COOLDOWN_S = 120
+PROBE_TIMEOUT_S = 180        # generous: lets a wedged claim clear (see step 2)
 MESH_TIMEOUT_S = 240
 SIZES = (128, 256)
 
@@ -403,27 +404,40 @@ def main() -> int:
     if d:
         diags.append(d)
 
-    # 2. Pre-flight probe, with one cool-down retry (SKILL.md: a killed
-    #    claim wedges the tunnel; retrying immediately re-wedges it). A
-    #    clean exit with ok:false (device answered wrong) counts as a
-    #    failure too — it gets the same diagnostic + retry treatment.
+    # 2. ONE generous pre-flight probe. A wedged claim can RESOLVE if the
+    #    process is left to wait (the wedge is an abandoned grant clearing
+    #    out), while every killed probe restarts the 10-15 min wedge clock
+    #    — so a single long-timeout probe strictly dominates the old
+    #    short-probe + cooldown + re-probe scheme, whose second kill
+    #    re-wedged the tunnel every time it ran (observed 0/3 successes).
+    #    A clean exit with ok:false (device answered wrong) is a failure.
     tpu = None
-    probe, d = _run_child("probe", min(PROBE_TIMEOUT_S, max(remaining() - 60,
-                                                            10)))
-    if probe is not None and not probe.get("ok"):
-        d = d or f"probe: device answered but ok=false ({probe})"
-        probe = None
-    if d:
-        diags.append(d)
-        cool = min(COOLDOWN_S, remaining() - PROBE_TIMEOUT_S - 45)
-        if cool > 20:
-            time.sleep(cool)
-            probe, d = _run_child("probe", PROBE_TIMEOUT_S)
-            if probe is not None and not probe.get("ok"):
-                d = d or f"probe: device answered but ok=false ({probe})"
-                probe = None
-            if d:
-                diags.append(d + " (after cooldown)")
+    probe = None
+    # Only probe when a success could still fund a measurement: step 3
+    # needs remaining > 75 after the probe, and a doomed truncated probe
+    # that gets killed restarts the wedge clock for the NEXT run too.
+    probe_budget = min(PROBE_TIMEOUT_S, remaining() - 120)
+    if probe_budget < 30:
+        diags.append(f"probe: skipped, only {remaining():.0f}s left")
+    else:
+        probe, d = _run_child("probe", probe_budget)
+        if probe is not None and not probe.get("ok"):
+            d = d or f"probe: device answered but ok=false ({probe})"
+            probe = None
+        if d:
+            diags.append(d)
+            # A CLEAN fast failure (bad session, nothing killed, nothing
+            # wedged) earns one immediate re-probe; a killed probe does
+            # not — the kill itself restarts the wedge clock, so
+            # re-probing just re-kills (observed 0/3).
+            rebudget = min(PROBE_TIMEOUT_S, remaining() - 120)
+            if "killed" not in d and rebudget >= 30:
+                probe, d = _run_child("probe", rebudget)
+                if probe is not None and not probe.get("ok"):
+                    d = d or f"probe: device answered but ok=false ({probe})"
+                    probe = None
+                if d:
+                    diags.append(d + " (re-probe)")
 
     # 3. Real measurement only behind a clean probe. Tunnel failures
     #    correlate per-process (a bad session fails every compile until the

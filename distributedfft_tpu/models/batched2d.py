@@ -33,6 +33,7 @@ from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
+from .base import _with_pad
 
 
 class Batched2DFFTPlan:
@@ -103,6 +104,8 @@ class Batched2DFFTPlan:
                     f"padded batch {local_b}")
         self._fwd = None
         self._inv = None
+        self._fwd_pure = None
+        self._inv_pure = None
 
     # -- shapes ------------------------------------------------------------
 
@@ -221,18 +224,44 @@ class Batched2DFFTPlan:
         return fn
 
     def _build(self, forward: bool):
+        pure, in_spec, out_spec = self._build_pure(forward)
+        if self.mesh is None:
+            return jax.jit(pure)
+        return jax.jit(pure,
+                       in_shardings=NamedSharding(self.mesh, in_spec),
+                       out_shardings=NamedSharding(self.mesh, out_spec))
+
+    def _build_pure(self, forward: bool):
+        """(pure_fn, in_spec, out_spec) — the specs travel with the
+        composition so the jit wrapper cannot drift from the shard_map."""
         if self.fft3d or self.shard == "batch":
             fn = self._chunked(lambda x: self._fft2(x, forward))
             if self.mesh is None:
-                return jax.jit(fn)
-            sm = jax.shard_map(fn, mesh=self.mesh, in_specs=self._in_spec,
-                               out_specs=self._out_spec)
-            return jax.jit(sm,
-                           in_shardings=NamedSharding(self.mesh, self._in_spec),
-                           out_shardings=NamedSharding(self.mesh, self._out_spec))
-        return self._build_slab(forward)
+                return fn, PartitionSpec(), PartitionSpec()
+            return (jax.shard_map(fn, mesh=self.mesh, in_specs=self._in_spec,
+                                  out_specs=self._out_spec),
+                    self._in_spec, self._out_spec)
+        return self._build_slab_pure(forward)
 
-    def _build_slab(self, forward: bool):
+    def forward_fn(self):
+        """Pure forward pipeline (``DistFFTPlan.forward_fn`` contract: no
+        jit, no sharding annotations — composes under user grad/jit).
+        Cached; pads logical-shaped input inside the trace."""
+        if self._fwd_pure is None:
+            self._fwd_pure = _with_pad(self._build_pure(True)[0],
+                                       self.input_shape,
+                                       self.input_padded_shape)
+        return self._fwd_pure
+
+    def inverse_fn(self):
+        """Pure inverse pipeline (see ``forward_fn``)."""
+        if self._inv_pure is None:
+            self._inv_pure = _with_pad(self._build_pure(False)[0],
+                                       self.output_shape,
+                                       self.output_padded_shape)
+        return self._inv_pure
+
+    def _build_slab_pure(self, forward: bool):
         """shard='x': 1D FFT y -> transpose (x-split -> y-split) -> 1D FFT x,
         the 2D restriction of the slab ZY_Then_X pipeline."""
         norm, be = self.config.norm, self.config.fft_backend
@@ -264,7 +293,5 @@ class Batched2DFFTPlan:
                     return lf.ifft(c, axis=2, norm=norm, backend=be)
                 return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be)
             in_spec, out_spec = self._out_spec, self._in_spec
-        sm = jax.shard_map(body, mesh=self.mesh, in_specs=in_spec,
-                           out_specs=out_spec)
-        return jax.jit(sm, in_shardings=NamedSharding(self.mesh, in_spec),
-                       out_shardings=NamedSharding(self.mesh, out_spec))
+        return (jax.shard_map(body, mesh=self.mesh, in_specs=in_spec,
+                              out_specs=out_spec), in_spec, out_spec)

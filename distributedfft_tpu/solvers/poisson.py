@@ -100,6 +100,7 @@ class PoissonSolver:
         self._scale = (1.0 / g.n_total
                        if plan.config.norm is pm.FFTNorm.NONE else 1.0)
         self._apply = None
+        self._solve_pure = None
 
     def _halved_axis(self) -> int:
         plan = self.plan
@@ -109,8 +110,9 @@ class PoissonSolver:
             return 1
         return 2
 
-    def _build_apply(self):
-        plan = self.plan
+    def _apply_pure(self):
+        """The spectral symbol multiply as a pure function (shared by the
+        jitted apply and ``solve_fn``)."""
         k1, k2, k3 = (jnp.asarray(k) for k in self._ks)
         scale = self._scale
 
@@ -121,10 +123,32 @@ class PoissonSolver:
                             -scale / jnp.where(k2sum > 0, k2sum, 1.0), 0.0)
             return c * inv.astype(c.real.dtype)
 
+        return apply
+
+    def _build_apply(self):
+        plan = self.plan
+        apply = self._apply_pure()
         if plan.mesh is not None:
             ns = plan.output_sharding
             return jax.jit(apply, in_shardings=ns, out_shardings=ns)
         return jax.jit(apply)
+
+    def solve_fn(self):
+        """Pure solve pipeline (forward -> symbol multiply -> inverse) with
+        no jit and no sharding annotations: composes under user transforms,
+        so ``jax.grad`` flows through the full distributed spectral solve
+        (see ``DistFFTPlan.forward_fn`` and tests/test_autodiff.py). Uses
+        the plan's transform family automatically (r2c or c2c)."""
+        if self._solve_pure is None:
+            plan = self.plan
+            fwd, inv = plan.forward_fn(), plan.inverse_fn()
+            apply = self._apply_pure()
+
+            def fn(f):
+                return inv(apply(fwd(f)))
+
+            self._solve_pure = fn
+        return self._solve_pure
 
     def solve(self, f):
         """u with ∇²u = f (periodic, zero-mean). Accepts logical or padded

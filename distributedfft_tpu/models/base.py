@@ -31,17 +31,23 @@ def _with_pad(pure, logical_shape, padded_shape):
     """Wrap a pure pipeline so logical-shaped input is zero-padded to the
     mesh-divisible padded shape (the traced analog of the exec_* padding
     preamble; ``jnp.pad``'s vjp slices the cotangent, so the wrapper stays
-    differentiable). Padded-shaped input passes through untouched."""
+    differentiable). Padded-shaped input passes through untouched; any
+    other shape raises, mirroring the exec_* validation — without this a
+    shape-agnostic pipeline would silently transform a wrong-shaped input
+    inconsistently with the plan."""
     logical = tuple(logical_shape)
     padded = tuple(padded_shape)
-    if logical == padded:
-        return pure
 
     import jax.numpy as jnp
 
     def fn(x):
         if tuple(x.shape) == logical:
-            x = jnp.pad(x, [(0, p - s) for p, s in zip(padded, logical)])
+            if logical != padded:
+                x = jnp.pad(x, [(0, p - s) for p, s in zip(padded, logical)])
+        elif tuple(x.shape) != padded:
+            raise ValueError(
+                f"input shape {tuple(x.shape)} matches neither the logical "
+                f"shape {logical} nor the padded shape {padded}")
         return pure(x)
 
     return fn
@@ -62,6 +68,13 @@ class DistFFTPlan:
         self.global_size = global_size
         self.partition = partition
         self.config = config or Config()
+        # MXU settings resolved ONCE at plan construction: when any Config
+        # knob is set, every builder reads this snapshot, so a later
+        # deprecated set_* call cannot split the plan's forward and inverse
+        # tracings across different knob values. An all-default Config
+        # resolves to None — such plans keep deferring to the mutable
+        # process defaults at trace time (legacy set_* behavior).
+        self._mxu_st = self.config.mxu_settings()
         self.mesh = mesh
         # Single-process fallback flag, exactly the reference's
         # ``fft3d = (pcnt == 1)`` (src/mpicufft.cpp:65).
@@ -157,18 +170,20 @@ class DistFFTPlan:
 
     def _fft3d_r2c(self, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
 
         def run(x):
-            return local_fft.rfftn_3d(x, norm=norm, backend=be)
+            return local_fft.rfftn_3d(x, norm=norm, backend=be, settings=st)
 
         return jax.jit(run) if jit else run
 
     def _fft3d_c2r(self, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
         shape = self.input_shape
 
         def run(c):
-            return local_fft.irfftn_3d(c, shape, norm=norm, backend=be)
+            return local_fft.irfftn_3d(c, shape, norm=norm, backend=be, settings=st)
 
         return jax.jit(run) if jit else run
 
@@ -176,12 +191,13 @@ class DistFFTPlan:
         """Single-device full 3D C2C (both directions unnormalized under
         FFTNorm.NONE, like cuFFT's CUFFT_FORWARD/CUFFT_INVERSE)."""
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
         axes = (-3, -2, -1)
 
         def run(c):
             if forward:
-                return local_fft.fftn(c, axes, norm=norm, backend=be)
-            return local_fft.ifftn(c, axes, norm=norm, backend=be)
+                return local_fft.fftn(c, axes, norm=norm, backend=be, settings=st)
+            return local_fft.ifftn(c, axes, norm=norm, backend=be, settings=st)
 
         return jax.jit(run) if jit else run
 

@@ -67,6 +67,8 @@ class Batched2DFFTPlan:
         self.batch, self.nx, self.ny = batch, nx, ny
         self.partition = partition
         self.config = config or pm.Config()
+        # Settings snapshot at construction (see DistFFTPlan.__init__).
+        self._mxu_st = self.config.mxu_settings()
         self.mesh = mesh
         self.shard = shard
         self.transform = transform
@@ -196,16 +198,17 @@ class Batched2DFFTPlan:
 
     def _fft2(self, x, forward: bool):
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
         if forward:
             if self.transform == "c2c":
-                c = lf.fft(x, axis=2, norm=norm, backend=be)
+                c = lf.fft(x, axis=2, norm=norm, backend=be, settings=st)
             else:
-                c = lf.rfft(x, axis=2, norm=norm, backend=be)
-            return lf.fft(c, axis=1, norm=norm, backend=be)
-        c = lf.ifft(x, axis=1, norm=norm, backend=be)
+                c = lf.rfft(x, axis=2, norm=norm, backend=be, settings=st)
+            return lf.fft(c, axis=1, norm=norm, backend=be, settings=st)
+        c = lf.ifft(x, axis=1, norm=norm, backend=be, settings=st)
         if self.transform == "c2c":
-            return lf.ifft(c, axis=2, norm=norm, backend=be)
-        return lf.irfft(c, n=self.ny, axis=2, norm=norm, backend=be)
+            return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
+        return lf.irfft(c, n=self.ny, axis=2, norm=norm, backend=be, settings=st)
 
     def _chunked(self, base):
         """Wrap a whole-(local-)batch transform in a sequential ``lax.map``
@@ -265,6 +268,7 @@ class Batched2DFFTPlan:
         """shard='x': 1D FFT y -> transpose (x-split -> y-split) -> 1D FFT x,
         the 2D restriction of the slab ZY_Then_X pipeline."""
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
         realigned = self.config.opt == 1
         nys_pad, nx_pad = self._nys_pad, self._nx_pad
         nx, ny, nys = self.nx, self.ny, self._ny_spec
@@ -273,25 +277,25 @@ class Batched2DFFTPlan:
         if forward:
             def body(xl):  # (B, nxb, ny)
                 if complex_mode:
-                    c = lf.fft(xl, axis=2, norm=norm, backend=be)
+                    c = lf.fft(xl, axis=2, norm=norm, backend=be, settings=st)
                 else:
-                    c = lf.rfft(xl, axis=2, norm=norm, backend=be)
+                    c = lf.rfft(xl, axis=2, norm=norm, backend=be, settings=st)
                 c = pad_axis_to(c, 2, nys_pad)
                 c = all_to_all_transpose(c, SLAB_AXIS, 2, 1,
                                          realigned=realigned)
                 c = slice_axis_to(c, 1, nx)
-                return lf.fft(c, axis=1, norm=norm, backend=be)
+                return lf.fft(c, axis=1, norm=norm, backend=be, settings=st)
             in_spec, out_spec = self._in_spec, self._out_spec
         else:
             def body(cl):  # (B, nx, nysb)
-                c = lf.ifft(cl, axis=1, norm=norm, backend=be)
+                c = lf.ifft(cl, axis=1, norm=norm, backend=be, settings=st)
                 c = pad_axis_to(c, 1, nx_pad)
                 c = all_to_all_transpose(c, SLAB_AXIS, 1, 2,
                                          realigned=realigned)
                 c = slice_axis_to(c, 2, nys)
                 if complex_mode:
-                    return lf.ifft(c, axis=2, norm=norm, backend=be)
-                return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be)
+                    return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
+                return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be, settings=st)
             in_spec, out_spec = self._out_spec, self._in_spec
         return (jax.shard_map(body, mesh=self.mesh, in_specs=in_spec,
                               out_specs=out_spec), in_spec, out_spec)

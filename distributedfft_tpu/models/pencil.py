@@ -271,15 +271,16 @@ class PencilFFTPlan(DistFFTPlan):
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
         be = self.config.fft_backend
+        st = self._mxu_st
         nzc_p2, ny_p1 = self._nzc_p2, self._ny_p1
         ny, nx = g.ny, g.nx
         complex_mode = self.transform == "c2c"
 
         def s1(xl):
             if complex_mode:
-                c = lf.fft(xl, axis=2, norm=norm, backend=be)
+                c = lf.fft(xl, axis=2, norm=norm, backend=be, settings=st)
             else:
-                c = lf.rfft(xl, axis=2, norm=norm, backend=be)
+                c = lf.rfft(xl, axis=2, norm=norm, backend=be, settings=st)
             if dims >= 2:
                 c = pad_axis_to(c, 2, nzc_p2)
             return c
@@ -289,7 +290,7 @@ class PencilFFTPlan(DistFFTPlan):
 
         def s2(cl):
             c = slice_axis_to(cl, 1, ny)
-            c = lf.fft(c, axis=1, norm=norm, backend=be)
+            c = lf.fft(c, axis=1, norm=norm, backend=be, settings=st)
             if dims >= 3:
                 c = pad_axis_to(c, 1, ny_p1)
             return c
@@ -299,7 +300,7 @@ class PencilFFTPlan(DistFFTPlan):
 
         def s3(cl):
             c = slice_axis_to(cl, 0, nx)
-            return lf.fft(c, axis=0, norm=norm, backend=be)
+            return lf.fft(c, axis=0, norm=norm, backend=be, settings=st)
 
         return (s1, t1 if dims >= 2 else None, s2,
                 t2 if dims >= 3 else None, s3)
@@ -309,12 +310,13 @@ class PencilFFTPlan(DistFFTPlan):
         g, norm = self.global_size, self.config.norm
         realigned = self.config.opt == 1
         be = self.config.fft_backend
+        st = self._mxu_st
         nx_p1, ny_p2 = self._nx_p1, self._ny_p2
         ny, nzc, nz = g.ny, self._nz_spec, g.nz
         complex_mode = self.transform == "c2c"
 
         def i3(cl):
-            c = lf.ifft(cl, axis=0, norm=norm, backend=be)
+            c = lf.ifft(cl, axis=0, norm=norm, backend=be, settings=st)
             return pad_axis_to(c, 0, nx_p1)
 
         def t2b(cl):
@@ -322,7 +324,7 @@ class PencilFFTPlan(DistFFTPlan):
 
         def i2(cl):
             c = slice_axis_to(cl, 1, ny)
-            c = lf.ifft(c, axis=1, norm=norm, backend=be)
+            c = lf.ifft(c, axis=1, norm=norm, backend=be, settings=st)
             return pad_axis_to(c, 1, ny_p2)
 
         def t1b(cl):
@@ -331,8 +333,8 @@ class PencilFFTPlan(DistFFTPlan):
         def i1(cl):
             c = slice_axis_to(cl, 2, nzc)
             if complex_mode:
-                return lf.ifft(c, axis=2, norm=norm, backend=be)
-            return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be)
+                return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
+            return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be, settings=st)
 
         return (i3 if dims >= 3 else None, t2b if dims >= 3 else None,
                 i2 if dims >= 2 else None, t1b if dims >= 2 else None, i1)
@@ -537,34 +539,36 @@ class PencilFFTPlan(DistFFTPlan):
 
     def _fft3d_r2c_d(self, dims: int, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
         complex_mode = self.transform == "c2c"
 
         def run(x):
             if complex_mode:
-                c = lf.fft(x, axis=2, norm=norm, backend=be)
+                c = lf.fft(x, axis=2, norm=norm, backend=be, settings=st)
             else:
-                c = lf.rfft(x, axis=2, norm=norm, backend=be)
+                c = lf.rfft(x, axis=2, norm=norm, backend=be, settings=st)
             if dims >= 2:
-                c = lf.fft(c, axis=1, norm=norm, backend=be)
+                c = lf.fft(c, axis=1, norm=norm, backend=be, settings=st)
             if dims >= 3:
-                c = lf.fft(c, axis=0, norm=norm, backend=be)
+                c = lf.fft(c, axis=0, norm=norm, backend=be, settings=st)
             return c
 
         return jax.jit(run) if jit else run
 
     def _fft3d_c2r_d(self, dims: int, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
+        st = self._mxu_st
         nz = self.global_size.nz
         complex_mode = self.transform == "c2c"
 
         def run(c):
             if dims >= 3:
-                c = lf.ifft(c, axis=0, norm=norm, backend=be)
+                c = lf.ifft(c, axis=0, norm=norm, backend=be, settings=st)
             if dims >= 2:
-                c = lf.ifft(c, axis=1, norm=norm, backend=be)
+                c = lf.ifft(c, axis=1, norm=norm, backend=be, settings=st)
             if complex_mode:
-                return lf.ifft(c, axis=2, norm=norm, backend=be)
-            return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be)
+                return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
+            return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be, settings=st)
 
         return jax.jit(run) if jit else run
 

@@ -259,17 +259,18 @@ class SlabFFTPlan(DistFFTPlan):
         s, norm, g = self._seq, self.config.norm, self.global_size
         realigned = self.config.opt == 1
         be = self.config.fft_backend
+        st = self._mxu_st
         split_pad, nx = self._split_pad, g.nx
 
         complex_mode = self.transform == "c2c"
 
         def first(xl):
             if complex_mode:
-                c = lf.fft(xl, axis=s.r2c_axis, norm=norm, backend=be)
+                c = lf.fft(xl, axis=s.r2c_axis, norm=norm, backend=be, settings=st)
             else:
-                c = lf.rfft(xl, axis=s.r2c_axis, norm=norm, backend=be)
+                c = lf.rfft(xl, axis=s.r2c_axis, norm=norm, backend=be, settings=st)
             for a in s.pre_axes:
-                c = lf.fft(c, axis=a, norm=norm, backend=be)
+                c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
             return pad_axis_to(c, s.split_axis, split_pad)
 
         def xpose(cl):
@@ -280,7 +281,7 @@ class SlabFFTPlan(DistFFTPlan):
             # Drop the zero pad rows of x before transforming along it.
             c = slice_axis_to(cl, 0, nx)
             for a in s.post_axes:
-                c = lf.fft(c, axis=a, norm=norm, backend=be)
+                c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
             return c
 
         return first, xpose, last
@@ -289,6 +290,7 @@ class SlabFFTPlan(DistFFTPlan):
         s, norm, g = self._seq, self.config.norm, self.global_size
         realigned = self.config.opt == 1
         be = self.config.fft_backend
+        st = self._mxu_st
         nx_pad, split_ext = self._nx_pad, self._split_ext
         real_n = g.nz if s.halved == "z" else g.ny
         complex_mode = self.transform == "c2c"
@@ -296,7 +298,7 @@ class SlabFFTPlan(DistFFTPlan):
         def first(cl):
             c = cl
             for a in reversed(s.post_axes):
-                c = lf.ifft(c, axis=a, norm=norm, backend=be)
+                c = lf.ifft(c, axis=a, norm=norm, backend=be, settings=st)
             return pad_axis_to(c, 0, nx_pad)
 
         def xpose(cl):
@@ -308,11 +310,11 @@ class SlabFFTPlan(DistFFTPlan):
             # remaining axes.
             c = slice_axis_to(cl, s.split_axis, split_ext)
             for a in reversed(s.pre_axes):
-                c = lf.ifft(c, axis=a, norm=norm, backend=be)
+                c = lf.ifft(c, axis=a, norm=norm, backend=be, settings=st)
             if complex_mode:
-                return lf.ifft(c, axis=s.r2c_axis, norm=norm, backend=be)
+                return lf.ifft(c, axis=s.r2c_axis, norm=norm, backend=be, settings=st)
             return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm,
-                            backend=be)
+                            backend=be, settings=st)
 
         return first, xpose, last
 

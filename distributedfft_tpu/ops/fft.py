@@ -14,7 +14,7 @@ expansion; ``"matmul"`` dispatches to the MXU matmul four-step backend
 (``ops/mxu_fft.py``) — the TPU-first alternative that keeps the FLOPs on the
 systolic array; ``"matmul-r2"`` is the same backend with radix-2 DIF
 splitting of the C2C stages down to MXU-depth matmuls (measured slower on
-v5e at 256^3 — see ``mxu_fft.set_radix2`` — raced for completeness);
+v5e at 256^3 — see ``mxu_fft.MXUSettings.radix2`` — raced for completeness);
 ``"pallas"`` runs the same four-step with hand-written Pallas kernels
 fusing the twiddle epilogue into the DFT matmul (``ops/pallas_fft.py``).
 Selected plan-wide via ``Config.fft_backend``.
@@ -22,6 +22,8 @@ Selected plan-wide via ``Config.fft_backend``.
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 from typing import Sequence, Tuple
 
 import jax.numpy as jnp
@@ -34,27 +36,6 @@ BACKENDS = ("xla", "matmul", "matmul-r2", "pallas")
 def _mxu():
     from . import mxu_fft
     return mxu_fft
-
-
-class _MXURadix2:
-    """``"matmul-r2"`` backend: the matmul four-step with radix-2 DIF
-    splitting of the C2C stages down to MXU-depth (128) matmuls
-    (``mxu_fft.set_radix2``). The toggle is trace-time, so this shim flips
-    it around each entry point; everything else (precision policy, norm
-    semantics) is the plain matmul backend."""
-
-    def __getattr__(self, name):
-        mx = _mxu()
-        fn = getattr(mx, name)
-
-        def wrapped(*args, **kwargs):
-            with mx.radix2():
-                return fn(*args, **kwargs)
-
-        return wrapped
-
-
-_MXU_R2 = _MXURadix2()
 
 
 def _pallas():
@@ -72,13 +53,28 @@ def validate_backend(backend: str) -> str:
 def _impl(backend: str):
     """Non-XLA implementation module for ``backend``, or None for "xla"."""
     b = validate_backend(backend)
-    if b == "matmul":
+    if b in ("matmul", "matmul-r2"):
         return _mxu()
-    if b == "matmul-r2":
-        return _MXU_R2
     if b == "pallas":
         return _pallas()
     return None
+
+
+def _settings_ctx(backend: str, settings):
+    """Context scoping per-call ``MXUSettings`` around a non-XLA dispatch
+    (the settings are read at TRACE time inside the backend; the ContextVar
+    scope makes the read thread/task-safe). ``"matmul-r2"`` is the matmul
+    backend with ``radix2`` forced on — overriding whatever the caller's
+    settings say, since the backend string is the more specific request.
+    The pallas backend reads only ``precision`` (via ``mxu_fft._prec_for``)
+    but is scoped identically so a plan's precision choice reaches it."""
+    mx = _mxu()
+    if backend == "matmul-r2":
+        settings = dataclasses.replace(settings or mx.current_settings(),
+                                       radix2=True)
+    if settings is None:
+        return contextlib.nullcontext()
+    return mx.use_settings(settings)
 
 
 def dtypes_for(double_prec: bool) -> Tuple[jnp.dtype, jnp.dtype]:
@@ -104,70 +100,86 @@ def _inv_norm(norm: FFTNorm) -> str:
     return "backward"
 
 
-def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
-    """Forward R2C along one axis (cuFFT ``execR2C`` analog, 1D case)."""
+def rfft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla",
+         settings=None):
+    """Forward R2C along one axis (cuFFT ``execR2C`` analog, 1D case).
+
+    ``settings`` (all entry points): optional ``mxu_fft.MXUSettings``
+    scoped around the dispatch — the per-plan alternative to the
+    deprecated ``set_*`` process globals. Ignored by the "xla" backend."""
     m = _impl(backend)
     if m is not None:
-        return m.rfft(x, axis=axis, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.rfft(x, axis=axis, norm=norm)
     return jnp.fft.rfft(x, axis=axis, norm=_fwd_norm(norm))
 
 
 def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE,
-          backend: str = "xla"):
+          backend: str = "xla", settings=None):
     """Inverse C2R along one axis; ``n`` is the real output extent (needed
     because the halved axis length ``n//2+1`` is ambiguous)."""
     m = _impl(backend)
     if m is not None:
-        return m.irfft(x, n=n, axis=axis, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.irfft(x, n=n, axis=axis, norm=norm)
     return jnp.fft.irfft(x, n=n, axis=axis, norm=_inv_norm(norm))
 
 
-def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
+def fft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla",
+        settings=None):
     """Forward C2C along one axis (cuFFT ``execC2C(..., CUFFT_FORWARD)``)."""
     m = _impl(backend)
     if m is not None:
-        return m.fft(x, axis=axis, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.fft(x, axis=axis, norm=norm)
     return jnp.fft.fft(x, axis=axis, norm=_fwd_norm(norm))
 
 
-def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
+def ifft(x, axis: int, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla",
+         settings=None):
     """Inverse C2C along one axis (cuFFT ``execC2C(..., CUFFT_INVERSE)``)."""
     m = _impl(backend)
     if m is not None:
-        return m.ifft(x, axis=axis, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.ifft(x, axis=axis, norm=norm)
     return jnp.fft.ifft(x, axis=axis, norm=_inv_norm(norm))
 
 
 def fftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE,
-         backend: str = "xla"):
+         backend: str = "xla", settings=None):
     m = _impl(backend)
     if m is not None:
-        return m.fftn(x, axes=axes, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.fftn(x, axes=axes, norm=norm)
     return jnp.fft.fftn(x, axes=tuple(axes), norm=_fwd_norm(norm))
 
 
 def ifftn(x, axes: Sequence[int], norm: FFTNorm = FFTNorm.NONE,
-          backend: str = "xla"):
+          backend: str = "xla", settings=None):
     m = _impl(backend)
     if m is not None:
-        return m.ifftn(x, axes=axes, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.ifftn(x, axes=axes, norm=norm)
     return jnp.fft.ifftn(x, axes=tuple(axes), norm=_inv_norm(norm))
 
 
-def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla"):
+def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE, backend: str = "xla",
+             settings=None):
     """Single-device full 3D R2C over the trailing three axes — the analog of
     the reference's ``cufftMakePlan3d`` single-process fallback
     (``src/mpicufft.cpp:65``, ``src/slab/default/mpicufft_slab.cpp:142-145``).
     The halved axis is z (the last), matching cuFFT's layout."""
     m = _impl(backend)
     if m is not None:
-        return m.rfftn_3d(x, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.rfftn_3d(x, norm=norm)
     return jnp.fft.rfftn(x, axes=(-3, -2, -1), norm=_fwd_norm(norm))
 
 
 def irfftn_3d(x, shape_3d: Tuple[int, int, int], norm: FFTNorm = FFTNorm.NONE,
-              backend: str = "xla"):
+              backend: str = "xla", settings=None):
     m = _impl(backend)
     if m is not None:
-        return m.irfftn_3d(x, shape_3d=shape_3d, norm=norm)
+        with _settings_ctx(backend, settings):
+            return m.irfftn_3d(x, shape_3d=shape_3d, norm=norm)
     return jnp.fft.irfftn(x, s=shape_3d, axes=(-3, -2, -1), norm=_inv_norm(norm))

@@ -37,9 +37,11 @@ through ``FFTNorm`` exactly like ``ops/fft.py``.
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import dataclasses
 import functools
 import math
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -53,30 +55,111 @@ from ..params import FFTNorm
 # contraction depth (= n) stays a multiple of the MXU's 128-deep pipeline.
 DIRECT_MAX = 512
 
-# DFT matmuls accumulate across n terms, so raw bf16 (Precision.DEFAULT) is
-# too lossy: measured on v5e at 256^3 f32 forward vs f64 truth it leaves
-# 5.4e-4 max relative error. Three-pass bf16 emulation (HIGH) reaches 8.2e-7
-# — O(f32 eps), on par with an f32 vendor FFT — at half the MXU passes of
-# HIGHEST (3.0e-8). HIGH is therefore the single-precision default; f64
-# inputs always use HIGHEST. Overridable per-call via ``set_precision`` for
-# accuracy/speed studies (the backend analog of the reference's comm-method
-# benchmark axis).
-_PREC_SINGLE = lax.Precision.HIGH
+
+@dataclasses.dataclass(frozen=True)
+class MXUSettings:
+    """Per-call backend knobs, read at TRACE time.
+
+    Replaces the four former module globals (precision / radix2 /
+    karatsuba / fourstep_einsum) so two plans with different settings can
+    coexist in one process: every public entry point accepts
+    ``settings=``, scoped through a ``contextvars.ContextVar`` for the
+    duration of the (trace-time) call, so concurrent traces in other
+    threads/contexts are unaffected. ``Config.mxu_settings()`` builds one
+    from plan configuration; the ``set_*`` module functions survive as
+    deprecated shims that mutate the process-default instance.
+
+    * ``precision`` — MXU precision for SINGLE-precision DFT matmuls.
+      Raw bf16 (DEFAULT) leaves 5.4e-4 max rel error at 256^3 (v5e,
+      f32 vs f64 truth); three-pass bf16 emulation (HIGH) reaches
+      8.2e-7 — O(f32 eps) — at half the MXU passes of HIGHEST (3.0e-8),
+      so HIGH is the default. f64 inputs always use HIGHEST.
+    * ``radix2`` — DIF splitting of C2C stages down to depth-128
+      matmuls (see the analysis above ``_fft_radix2``).
+    * ``karatsuba`` — 3-matmul complex multiply (see ``_matmul_F``).
+    * ``fourstep_einsum`` — relayout-free four-step (see
+      ``_fourstep_einsum``).
+    """
+
+    precision: lax.Precision = lax.Precision.HIGH
+    radix2: bool = False
+    karatsuba: bool = False
+    fourstep_einsum: bool = False
+
+    @classmethod
+    def make(cls, precision=None, radix2: bool = False,
+             karatsuba: bool = False,
+             fourstep_einsum: bool = False) -> "MXUSettings":
+        """Build from loosely-typed values (precision may be a string
+        name in any case, a ``lax.Precision``, or None for the HIGH
+        default)."""
+        p = lax.Precision.HIGH if precision is None else as_precision(
+            precision)
+        return cls(p, bool(radix2), bool(karatsuba), bool(fourstep_einsum))
+
+
+def as_precision(p) -> lax.Precision:
+    """Coerce a ``lax.Precision`` or its string name (any case) — string
+    values come from ``Config.mxu_precision``, which validates
+    case-insensitively, so the coercion must be too."""
+    return p if isinstance(p, lax.Precision) else lax.Precision(
+        str(p).lower())
+
+
+# Process-default settings, mutated only by the deprecated ``set_*`` shims.
+_DEFAULTS = MXUSettings()
+
+# Active per-call override; None -> fall through to _DEFAULTS. A ContextVar
+# (not a bare global) so a trace running in another thread or asyncio task
+# never observes a neighbour's scoped settings.
+_ACTIVE: contextvars.ContextVar[Optional[MXUSettings]] = \
+    contextvars.ContextVar("mxu_settings", default=None)
+
+
+def current_settings() -> MXUSettings:
+    """Settings in effect for the current context (scoped override if one
+    is active, else the process defaults)."""
+    return _ACTIVE.get() or _DEFAULTS
+
+
+def default_settings() -> MXUSettings:
+    """The process-default settings (what the deprecated ``set_*`` shims
+    mutate), ignoring any active scoped override — the base
+    ``Config.mxu_settings()`` resolves unset knobs against."""
+    return _DEFAULTS
+
+
+@contextlib.contextmanager
+def use_settings(settings: Optional[MXUSettings]):
+    """Scope ``settings`` as the active MXUSettings for this context.
+    ``None`` is a no-op (keeps whatever is already in effect)."""
+    if settings is None:
+        yield
+        return
+    token = _ACTIVE.set(settings)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+def _set_default(**kw) -> None:
+    global _DEFAULTS
+    _DEFAULTS = dataclasses.replace(_DEFAULTS, **kw)
 
 
 def set_precision(p) -> None:
-    """Override the MXU precision used for single-precision DFT matmuls
-    (``lax.Precision`` or its string name).
-
-    The value is read at TRACE time: call this before the transform is
-    first jitted/traced. Already-compiled programs keep the precision they
-    were traced with (jit caches key on shapes/dtypes, not this global)."""
-    global _PREC_SINGLE
-    _PREC_SINGLE = lax.Precision(p) if not isinstance(p, lax.Precision) else p
+    """DEPRECATED shim: set the process-DEFAULT MXU precision for
+    single-precision DFT matmuls (``lax.Precision`` or its string name).
+    Prefer ``Config(mxu_precision=...)`` / an explicit ``MXUSettings`` —
+    this global default is read at TRACE time and is not thread-scoped.
+    Already-compiled programs keep the precision they were traced with."""
+    _set_default(precision=as_precision(p))
 
 
 def _prec_for(dtype):
-    return lax.Precision.HIGHEST if _is_double(dtype) else _PREC_SINGLE
+    return (lax.Precision.HIGHEST if _is_double(dtype)
+            else current_settings().precision)
 
 
 # ---------------------------------------------------------------------------
@@ -136,16 +219,14 @@ def _split(n: int) -> Tuple[int, int]:
 # at 256^3 it is a net LOSS (~1.9-2.2 ms roundtrip vs ~1.5 ms): at these
 # sizes the stages are close to HBM-bound, so trimming MXU passes while
 # adding elementwise operand traffic costs more than it saves. Off by
-# default; the toggle stays as a benchmarkable axis for larger / more
-# compute-bound shapes.
-_KARATSUBA = False
+# default (``MXUSettings.karatsuba``); the toggle stays as a benchmarkable
+# axis for larger / more compute-bound shapes.
 
 
 def set_karatsuba(on: bool) -> None:
-    """Toggle the 3-matmul complex-multiply form (trace-time flag, like
-    ``set_precision``)."""
-    global _KARATSUBA
-    _KARATSUBA = bool(on)
+    """DEPRECATED shim: set the process-DEFAULT 3-matmul complex-multiply
+    form (prefer ``Config(mxu_karatsuba=...)``)."""
+    _set_default(karatsuba=bool(on))
 
 
 # Radix-2 splitting of the C2C stages. A direct depth-n DFT matmul costs
@@ -167,27 +248,22 @@ def set_karatsuba(on: bool) -> None:
 # backend ("matmul-r2") because the trade-off flips where compute dominates
 # (deeper axes / cheaper memory systems); both input halves are contiguous
 # (DIF, not DIT), so no strided gather on the input side.
-_RADIX2 = False
 _R2_BASE = 128
 
 
 def set_radix2(on: bool) -> None:
-    """Toggle radix-2 DIF splitting of C2C stages down to depth-128
-    matmuls (trace-time flag, like ``set_precision``)."""
-    global _RADIX2
-    _RADIX2 = bool(on)
+    """DEPRECATED shim: set the process-DEFAULT radix-2 DIF splitting of
+    C2C stages (prefer backend "matmul-r2" / an explicit MXUSettings)."""
+    _set_default(radix2=bool(on))
 
 
 @contextlib.contextmanager
 def radix2(on: bool = True):
-    """Scoped ``set_radix2``: restores the previous flag on exit (the
-    "matmul-r2" backend shim and tests wrap trace-time calls in this)."""
-    saved = _RADIX2
-    set_radix2(on)
-    try:
+    """Scoped radix-2 override: the current settings with ``radix2=on``,
+    context-local (thread/task-safe), restored on exit."""
+    with use_settings(dataclasses.replace(current_settings(),
+                                          radix2=bool(on))):
         yield
-    finally:
-        set_radix2(saved)
 
 
 @functools.lru_cache(maxsize=None)
@@ -216,7 +292,7 @@ def _fft_radix2(x, inverse: bool):
 def _matmul_F(x, F_np: np.ndarray):
     """x @ F for complex x and a constant complex DFT matrix."""
     prec = _prec_for(x.dtype)
-    if not _KARATSUBA:
+    if not current_settings().karatsuba:
         return jnp.matmul(x, jnp.asarray(F_np), precision=prec)
     rdt = np.float64 if _is_double(x.dtype) else np.float32
     Fr = jnp.asarray(np.ascontiguousarray(F_np.real.astype(rdt)))
@@ -249,29 +325,24 @@ def _rmatmul_F(x_real, F_np: np.ndarray):
 # einsum 167.3 ms vs swapaxes 137.2 ms — XLA's layout assignment for the
 # non-trailing contraction is WORSE than the explicit relayout pipeline,
 # so the swapaxes path stays the default and the einsum variant remains a
-# benchmarkable toggle (``set_fourstep_einsum(True)``; exact same math,
+# benchmarkable toggle (``MXUSettings.fourstep_einsum``; exact same math,
 # bit-identical in f64 on CPU). Applies when both factors are direct-sized
 # (n <= DIRECT_MAX^2 = 256k — every practical axis).
-_FOURSTEP_EINSUM = False
 
 
 def set_fourstep_einsum(on: bool) -> None:
-    """Toggle the einsum (relayout-free) four-step formulation (trace-time
-    flag, like ``set_precision``)."""
-    global _FOURSTEP_EINSUM
-    _FOURSTEP_EINSUM = bool(on)
+    """DEPRECATED shim: set the process-DEFAULT einsum (relayout-free)
+    four-step formulation (prefer ``Config(mxu_fourstep_einsum=...)``)."""
+    _set_default(fourstep_einsum=bool(on))
 
 
 @contextlib.contextmanager
 def fourstep_einsum(on: bool = True):
-    """Scoped ``set_fourstep_einsum``: restores the previous flag on exit
-    (same pattern as ``radix2``)."""
-    saved = _FOURSTEP_EINSUM
-    set_fourstep_einsum(on)
-    try:
+    """Scoped fourstep-einsum override, context-local (same pattern as
+    ``radix2``)."""
+    with use_settings(dataclasses.replace(current_settings(),
+                                          fourstep_einsum=bool(on))):
         yield
-    finally:
-        set_fourstep_einsum(saved)
 
 
 def _fourstep_einsum(x4, inverse: bool, n1: int, n2: int, dbl: bool):
@@ -302,14 +373,15 @@ def _fft_last(x, inverse: bool):
     """Unnormalized DFT along the last axis of a complex array."""
     n = x.shape[-1]
     dbl = _is_double(x.dtype)
-    if _RADIX2 and n > _R2_BASE and n % 2 == 0:
+    st = current_settings()
+    if st.radix2 and n > _R2_BASE and n % 2 == 0:
         return _fft_radix2(x, inverse)
     if n <= DIRECT_MAX:
         return _matmul_F(x, _dft_np(n, inverse, dbl))
     n1, n2 = _split(n)
     if n1 == 1:  # prime length: direct full-size matmul
         return _matmul_F(x, _dft_np(n, inverse, dbl))
-    if _FOURSTEP_EINSUM and n1 <= DIRECT_MAX and n2 <= DIRECT_MAX:
+    if st.fourstep_einsum and n1 <= DIRECT_MAX and n2 <= DIRECT_MAX:
         return _fourstep_einsum(x.reshape(x.shape[:-1] + (n2, n1)),
                                 inverse, n1, n2, dbl)
     # x[..., s*n1 + r] -> A[..., r, s]
@@ -331,7 +403,8 @@ def _rfft_last(x):
     n1, n2 = _split(n)
     if n1 == 1:
         return _rmatmul_F(x, _dft_np(n, False, dbl)[:, :n_out])
-    if _FOURSTEP_EINSUM and n1 <= DIRECT_MAX and n2 <= DIRECT_MAX:
+    if current_settings().fourstep_einsum and n1 <= DIRECT_MAX \
+            and n2 <= DIRECT_MAX:
         full = _fourstep_einsum(x.reshape(x.shape[:-1] + (n2, n1)),
                                 False, n1, n2, dbl)
         return full[..., :n_out]

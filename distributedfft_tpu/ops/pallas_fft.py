@@ -95,7 +95,9 @@ _N_MAX = 1024
 
 # MXU precision follows the matmul backend's policy (HIGH three-pass bf16
 # for f32 — measured 8.2e-7 fwd rel err at 256^3 — HIGHEST only for f64,
-# which this kernel routes to the fallback anyway). See mxu_fft._PREC_SINGLE.
+# which this kernel routes to the fallback anyway). See
+# mxu_fft.MXUSettings.precision (read via mxu_fft._prec_for, so a plan's
+# context-scoped settings reach this kernel too).
 def _prec():
     return mx._prec_for(jnp.float32)
 
@@ -128,7 +130,7 @@ def _planes(a):
 
     Mosaic rejects ``precision=HIGH`` inside kernels (only DEFAULT/HIGHEST
     lower), so the HIGH policy — three-pass bf16 emulation, the measured
-    accuracy/speed sweet spot (mxu_fft._PREC_SINGLE) — is emulated by
+    accuracy/speed sweet spot (mxu_fft.MXUSettings.precision) — is emulated by
     splitting each operand into bf16 hi + residual lo here and taking the
     three significant cross products in ``_dot2``, exactly what XLA emits
     for HIGH outside Pallas."""

@@ -33,6 +33,11 @@ from typing import List, Optional, Sequence, Tuple
 from .utils import native_planner
 
 
+# Valid Config.mxu_precision names (lax.Precision's string forms); a plain
+# set so params.py stays importable without jax.
+_MXU_PRECISIONS = frozenset({"default", "high", "highest"})
+
+
 class CommMethod(enum.Enum):
     """Global-redistribution strategy (reference ``params.hpp:83-85``)."""
 
@@ -256,10 +261,20 @@ class Config:
     ``fft_backend`` selects the local-transform implementation: ``"xla"``
     (XLA's FFT expansion), ``"matmul"`` (MXU four-step DFT matmuls,
     ``ops/mxu_fft.py``), ``"matmul-r2"`` (same with radix-2 DIF splitting
-    down to MXU-depth matmuls, ``mxu_fft.set_radix2``), or ``"pallas"``
-    (Pallas kernels fusing the four-step twiddle into the DFT matmul,
-    ``ops/pallas_fft.py``) — the TPU analog of the reference's cuFFT-plan
-    choice at L0 (``include/cufft.hpp:23-61``).
+    down to MXU-depth matmuls), or ``"pallas"`` (Pallas kernels fusing the
+    four-step twiddle into the DFT matmul, ``ops/pallas_fft.py``) — the TPU
+    analog of the reference's cuFFT-plan choice at L0
+    (``include/cufft.hpp:23-61``).
+
+    ``mxu_precision`` / ``mxu_karatsuba`` / ``mxu_fourstep_einsum`` are the
+    matmul-family backend knobs as PLAN state (read at trace time through a
+    context-scoped ``mxu_fft.MXUSettings``, so two plans with different
+    settings coexist in one process). Each knob is tri-state: None defers
+    PER KNOB to the deprecated ``mxu_fft.set_*`` process defaults;
+    an explicit value wins. ``mxu_precision`` is the single-precision
+    DFT-matmul MXU precision: "default" (raw bf16), "high" (the measured
+    accuracy/speed sweet spot on v5e — also the process default), or
+    "highest"; f64 always runs HIGHEST.
     """
 
     comm_method: CommMethod = CommMethod.ALL2ALL
@@ -274,10 +289,44 @@ class Config:
     norm: FFTNorm = FFTNorm.NONE
     benchmark_dir: str = "benchmarks"
     fft_backend: str = "xla"
+    mxu_precision: Optional[str] = None
+    mxu_karatsuba: Optional[bool] = None
+    mxu_fourstep_einsum: Optional[bool] = None
 
     def __post_init__(self):
         from .ops.fft import validate_backend  # lazy: ops.fft imports params
         validate_backend(self.fft_backend)
+        if self.mxu_precision is not None and \
+                str(self.mxu_precision).lower() not in _MXU_PRECISIONS:
+            raise ValueError(
+                f"mxu_precision must be one of {sorted(_MXU_PRECISIONS)} "
+                f"or None, got {self.mxu_precision!r}")
+
+    def mxu_settings(self):
+        """The plan's ``mxu_fft.MXUSettings``, or None when every knob is
+        None — None lets the deprecated ``set_*`` process defaults keep
+        applying wholesale, preserving pre-Config behavior. When any knob
+        is set, the OTHER knobs still fall back per-knob to the process
+        defaults in effect at build time (a later ``set_*`` call does not
+        reach an already-built plan)."""
+        if (self.mxu_precision is None and self.mxu_karatsuba is None
+                and self.mxu_fourstep_einsum is None):
+            return None
+        import dataclasses as dc
+
+        from .ops import mxu_fft as mx  # lazy: imports jax
+        # PROCESS defaults, not current_settings(): a plan built inside an
+        # ambient use_settings()/radix2() scope must not snapshot that
+        # scope's overrides into its permanent state.
+        base = mx.default_settings()
+        kw = {}
+        if self.mxu_precision is not None:
+            kw["precision"] = mx.as_precision(self.mxu_precision)
+        if self.mxu_karatsuba is not None:
+            kw["karatsuba"] = self.mxu_karatsuba
+        if self.mxu_fourstep_einsum is not None:
+            kw["fourstep_einsum"] = self.mxu_fourstep_einsum
+        return dc.replace(base, **kw)
 
     def resolved_comm2(self) -> CommMethod:
         return self.comm_method2 if self.comm_method2 is not None else self.comm_method

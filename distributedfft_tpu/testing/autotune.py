@@ -13,7 +13,7 @@ roundtrip, round 2): xla 4.89 ms, matmul@HIGHEST 2.61 ms, matmul@HIGH
 1.48 ms, matmul-r2@HIGH 2.64 ms, pallas (fused two-stage kernels) 3.17 ms —
 a 3.3x spread that no static default gets right on every platform (on CPU,
 xla wins by a similar margin; the pallas negative-result analysis lives in
-``ops/pallas_fft.py``, the radix-2 one at ``mxu_fft.set_radix2``).
+``ops/pallas_fft.py``, the radix-2 one at ``mxu_fft.MXUSettings.radix2``).
 
 Timing comes from the shared chained-roundtrip harness
 (``testing/chaintimer.py``, also used by bench.py): median of (t_K - t_1)
@@ -50,7 +50,8 @@ class Candidate:
 
 
 def _measure(shape, backend: str, k: int, repeats: int, inner: int,
-             x, x_absmax: float) -> Tuple[float, float, Optional[str]]:
+             x, x_absmax: float,
+             settings=None) -> Tuple[float, float, Optional[str]]:
     """(per-iteration ms, roundtrip rel err, degeneracy note)."""
     import jax
     import jax.numpy as jnp
@@ -62,13 +63,15 @@ def _measure(shape, backend: str, k: int, repeats: int, inner: int,
     # the TPU tunnel).
     scale = 1.0 / float(np.prod(shape))
     err_fn = jax.jit(lambda a: jnp.max(jnp.abs(
-        lf.irfftn_3d(lf.rfftn_3d(a, norm=FFTNorm.NONE, backend=backend),
-                     tuple(shape), norm=FFTNorm.NONE, backend=backend)
+        lf.irfftn_3d(lf.rfftn_3d(a, norm=FFTNorm.NONE, backend=backend,
+                                 settings=settings),
+                     tuple(shape), norm=FFTNorm.NONE, backend=backend,
+                     settings=settings)
         * scale - a)))
     rel = float(err_fn(x)) / x_absmax
 
-    fn1 = chaintimer.roundtrip_chain(1, shape, backend)
-    fnK = chaintimer.roundtrip_chain(k, shape, backend)
+    fn1 = chaintimer.roundtrip_chain(1, shape, backend, settings=settings)
+    fnK = chaintimer.roundtrip_chain(k, shape, backend, settings=settings)
     float(fn1(x))  # compile + warm
     float(fnK(x))
     per_ms, _ = chaintimer.median_pair_diff_ms(fn1, fnK, x, k, repeats, inner)
@@ -115,28 +118,30 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k}): the (t_K - t_1) pair "
                          "difference needs at least one extra iteration")
-    saved_prec = mxu_fft._PREC_SINGLE
-    try:
-        for c in cands:
-            # Matmul variants race at their own precision; every other
-            # backend must race at the DEPLOYED precision (the pre-autotune
-            # global), not whatever the previous candidate left behind —
-            # pallas reads the same global via mxu_fft._prec_for.
-            mxu_fft._PREC_SINGLE = saved_prec
-            if c.precision is not None:
-                mxu_fft.set_precision(c.precision)
-            try:
-                c.per_iter_ms, c.rel_err, c.error = _measure(
-                    shape, c.backend, k, repeats, inner, x, x_absmax)
-                c.ok = (c.error is None and c.rel_err <= budget_rel_err)
-            except Exception as e:  # backend unavailable on this platform
-                c.error = f"{type(e).__name__}: {e}"
-            if verbose:
-                print(f"  {c.label:16s} {c.per_iter_ms:8.3f} ms  "
-                      f"rel_err {c.rel_err:.2e}  ok={c.ok}"
-                      + (f"  ({c.error})" if c.error else ""), flush=True)
-    finally:
-        mxu_fft._PREC_SINGLE = saved_prec
+    import dataclasses as dc
+    for c in cands:
+        # Matmul variants race at their own precision via an explicit
+        # MXUSettings (context-scoped, so nothing leaks between candidates
+        # or into the process defaults). The base is the DEPLOYED defaults
+        # — only the precision knob varies — so the measurement predicts
+        # the configuration apply_best's Config resolves to at build time
+        # (its non-precision knobs fall back to the same defaults).
+        # Candidates without a precision (xla, pallas, f64 matmul) race at
+        # the deployed defaults unchanged.
+        st = (dc.replace(mxu_fft.current_settings(),
+                         precision=mxu_fft.as_precision(c.precision))
+              if c.precision is not None else None)
+        try:
+            c.per_iter_ms, c.rel_err, c.error = _measure(
+                shape, c.backend, k, repeats, inner, x, x_absmax,
+                settings=st)
+            c.ok = (c.error is None and c.rel_err <= budget_rel_err)
+        except Exception as e:  # backend unavailable on this platform
+            c.error = f"{type(e).__name__}: {e}"
+        if verbose:
+            print(f"  {c.label:16s} {c.per_iter_ms:8.3f} ms  "
+                  f"rel_err {c.rel_err:.2e}  ok={c.ok}"
+                  + (f"  ({c.error})" if c.error else ""), flush=True)
 
     # NaN per_iter_ms (crashed before timing) must not poison the sort key:
     # tuple comparison with NaN gives undefined ordering among failures.
@@ -302,16 +307,15 @@ def apply_best_comm(candidates: List[CommCandidate], base_config=None):
 
 
 def apply_best(candidates: List[Candidate]):
-    """Translate the winning candidate into a ``Config`` (and set the MXU
-    precision global when the winner is a matmul variant). Raises when no
-    candidate passed."""
-    from ..ops import mxu_fft
+    """Translate the winning candidate into a ``Config``: the backend plus,
+    for matmul variants, the raced precision as PLAN state
+    (``Config.mxu_precision`` — no process globals are touched, so other
+    plans in the process are unaffected). Raises when no candidate
+    passed."""
     from ..params import Config
 
     best = candidates[0]
     if not best.ok:
         raise RuntimeError(
             f"autotune: no usable backend; {describe_failures(candidates)}")
-    if best.precision is not None:
-        mxu_fft.set_precision(best.precision)
-    return Config(fft_backend=best.backend)
+    return Config(fft_backend=best.backend, mxu_precision=best.precision)

@@ -22,9 +22,13 @@ import numpy as np
 from ..params import FFTNorm
 
 
-def roundtrip_chain(k: int, shape, backend: str):
+def roundtrip_chain(k: int, shape, backend: str, settings=None):
     """Jitted scalar-fenced chain of ``k`` R2C+C2R roundtrips of ``shape``
     (dtype follows the input array: f32 or f64).
+
+    ``settings`` is an optional ``mxu_fft.MXUSettings`` threaded into every
+    local transform — how autotune races precision variants without
+    touching the process defaults.
 
     ``backend="matmul-planes"`` uses the all-real-planes formulation
     (``mxu_fft.rfftn_3d_planes``): the identical DFT matmuls with no
@@ -41,13 +45,15 @@ def roundtrip_chain(k: int, shape, backend: str):
 
     if backend == "matmul-planes":
         def body(i, v):
-            cr, ci = mx.rfftn_3d_planes(v)
-            return mx.irfftn_3d_planes(cr, ci, tuple(shape)) * scale
+            with mx.use_settings(settings):
+                cr, ci = mx.rfftn_3d_planes(v)
+                return mx.irfftn_3d_planes(cr, ci, tuple(shape)) * scale
     else:
         def body(i, v):
-            c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend)
+            c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend,
+                            settings=settings)
             r = lf.irfftn_3d(c, tuple(shape), norm=FFTNorm.NONE,
-                             backend=backend)
+                             backend=backend, settings=settings)
             # FFTNorm.NONE leaves both directions unnormalized (cuFFT
             # convention); rescaling keeps the chained value bounded.
             return r * scale

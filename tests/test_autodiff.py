@@ -145,6 +145,18 @@ def test_forward_fn_pads_like_exec(devices, rng):
     np.testing.assert_allclose(got, w, atol=1e-10)
 
 
+def test_forward_fn_rejects_wrong_shape(devices):
+    """A shape matching neither the logical nor the padded extent must
+    raise (ADVICE r2: without this, shape-agnostic pipelines silently
+    compute a transform inconsistent with the plan)."""
+    g = dfft.GlobalSize(20, 16, 16)  # padded to 24 over 8 ranks
+    plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(8),
+                            dfft.Config(double_prec=True,
+                                        fft_backend="matmul"))
+    with pytest.raises(ValueError, match="neither the logical"):
+        plan.forward_fn()(np.zeros((21, 16, 16)))
+
+
 def test_forward_fn_is_cached(devices):
     """Repeated forward_fn() calls return the SAME callable, so a user's
     jit cache (keyed on function identity) does not retrace per call."""

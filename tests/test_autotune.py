@@ -33,12 +33,10 @@ def test_winner_meets_budget_and_sorts_first(ranked):
 
 
 def test_apply_best_returns_config(ranked):
-    saved = mxu_fft._PREC_SINGLE
-    try:
-        cfg = at.apply_best(ranked)
-        assert cfg.fft_backend == ranked[0].backend
-    finally:
-        mxu_fft._PREC_SINGLE = saved
+    cfg = at.apply_best(ranked)
+    assert cfg.fft_backend == ranked[0].backend
+    # The raced precision travels as PLAN state, not a process global.
+    assert cfg.mxu_precision == ranked[0].precision
 
 
 def test_apply_best_raises_with_diagnosis():
@@ -71,9 +69,11 @@ def test_describe_failures_reports_errors_not_budget():
     assert "boom" in msg and "over budget" in msg
 
 
-def test_precision_global_restored(ranked):
-    # autotune_local_fft must not leave the module precision changed
-    assert mxu_fft._PREC_SINGLE == mxu_fft.lax.Precision.HIGH
+def test_precision_default_untouched(ranked):
+    # autotune_local_fft races precisions via context-scoped MXUSettings;
+    # the process-default settings must come through unchanged.
+    assert mxu_fft.current_settings() == mxu_fft.MXUSettings()
+    assert mxu_fft.current_settings().precision == mxu_fft.lax.Precision.HIGH
 
 
 def test_k_below_two_rejected():

@@ -51,6 +51,25 @@ def test_full_orchestration_off_tunnel():
     assert d.get("geometry_gb_per_s"), d
 
 
+def test_bench_sizes_tolerates_malformed_env(monkeypatch):
+    """A typo'd DFFT_BENCH_SIZES must degrade to the default sweep, not
+    crash the parent after the mesh metrics were gathered (ADVICE r2)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    for raw, want in [("", bench.SIZES), (",,", bench.SIZES),
+                      ("abc", bench.SIZES), ("0,-4", bench.SIZES),
+                      ("128, 256", (128, 256)), ("1024", (1024,)),
+                      ("64,oops,256", (64, 256))]:
+        monkeypatch.setenv("DFFT_BENCH_SIZES", raw)
+        assert bench._bench_sizes() == want, raw
+    monkeypatch.setenv("DFFT_BENCH_SIZES", "512,junk")
+    assert bench._headline_size() == "512"
+    monkeypatch.setenv("DFFT_BENCH_SIZES", "512,256")
+    assert bench._headline_size() == "256"
+
+
 def test_child_json_contract():
     """Each child prints its own one-line JSON even under the test hooks."""
     env = dict(os.environ)

@@ -143,11 +143,82 @@ def test_set_precision_accepts_names():
     from distributedfft_tpu.ops import mxu_fft as mf
     try:
         mf.set_precision("highest")
-        assert mf._PREC_SINGLE == lax.Precision.HIGHEST
+        assert mf.current_settings().precision == lax.Precision.HIGHEST
         mf.set_precision(lax.Precision.HIGH)
-        assert mf._PREC_SINGLE == lax.Precision.HIGH
+        assert mf.current_settings().precision == lax.Precision.HIGH
     finally:
         mf.set_precision(lax.Precision.HIGH)
+
+
+class TestMXUSettings:
+    """Per-plan backend knobs (VERDICT r2 weak#7): settings travel as
+    Config/plan state through a context-scoped MXUSettings instead of the
+    four former module globals, so differently-configured plans coexist."""
+
+    def test_config_builds_settings(self):
+        from jax import lax
+        cfg = dfft.Config(fft_backend="matmul", mxu_precision="highest",
+                          mxu_karatsuba=True)
+        st = cfg.mxu_settings()
+        assert st.precision == lax.Precision.HIGHEST
+        assert st.karatsuba and not st.fourstep_einsum
+
+    def test_config_default_settings_is_none(self):
+        # None defers to the deprecated process defaults (back-compat).
+        assert dfft.Config(fft_backend="matmul").mxu_settings() is None
+
+    def test_config_rejects_bad_precision(self):
+        with pytest.raises(ValueError, match="mxu_precision"):
+            dfft.Config(mxu_precision="bf16")
+
+    def test_two_plans_with_different_settings_coexist(self, rng):
+        """The VERDICT 'done' criterion: trace two differently-configured
+        plans in one process and observe both tracings honored (karatsuba
+        changes the complex-multiply structure: 3 real dots per C2C stage
+        vs 1 complex dot) with no global state mutated."""
+        import jax
+
+        from distributedfft_tpu.ops import mxu_fft as mf
+
+        g = dfft.GlobalSize(8, 8, 8)
+        part = dfft.SlabPartition(1)
+        plain = dfft.SlabFFTPlan(g, part, dfft.Config(fft_backend="matmul"))
+        kara = dfft.SlabFFTPlan(
+            g, part, dfft.Config(fft_backend="matmul", mxu_karatsuba=True))
+        x = rng.random(g.shape).astype(np.float32)
+        jx_plain = str(jax.make_jaxpr(plain.forward_fn())(x))
+        jx_kara = str(jax.make_jaxpr(kara.forward_fn())(x))
+        assert jx_kara.count("dot_general") > jx_plain.count("dot_general")
+        assert mf.current_settings() == mf.MXUSettings()  # nothing leaked
+        # and both compute the same transform
+        ref = np.fft.rfftn(x)
+        assert _rel(np.asarray(plain.exec_r2c(x)), ref) < 1e-4
+        assert _rel(np.asarray(kara.exec_r2c(x)), ref) < 1e-4
+
+    def test_settings_kwarg_overrides_process_default(self, rng):
+        """An explicit settings= beats the deprecated set_* default, and
+        the scoped override never escapes the call."""
+        import jax
+
+        from distributedfft_tpu.ops import mxu_fft as mf
+
+        # 1024 > DIRECT_MAX forces the four-step split (32*32), where the
+        # einsum and swapaxes formulations trace differently.
+        x = rng.random((4, 1024)).astype(np.float32)
+        try:
+            mf.set_fourstep_einsum(True)  # process default: einsum on
+            st_off = mf.MXUSettings.make(fourstep_einsum=False)
+            from distributedfft_tpu.ops import fft as lf
+            jx_default = str(jax.make_jaxpr(
+                lambda a: lf.fft(a, axis=-1, backend="matmul"))(
+                    x.astype(np.complex64)))
+            jx_off = str(jax.make_jaxpr(
+                lambda a: lf.fft(a, axis=-1, backend="matmul",
+                                 settings=st_off))(x.astype(np.complex64)))
+            assert jx_default != jx_off
+        finally:
+            mf.set_fourstep_einsum(False)
+        assert mf.current_settings() == mf.MXUSettings()
 
 
 def test_plan_prime_dims_matmul_backend(devices, rng):
@@ -222,12 +293,12 @@ class TestRadix2:
         assert _rel(goti, n * np.fft.ifft(x, axis=-1)) < tol
 
     def test_backend_shim_restores_flag(self, rng):
-        """The "matmul-r2" backend flips the trace-time flag only for the
-        duration of the call."""
-        assert mxu_fft._RADIX2 is False
+        """The "matmul-r2" backend scopes radix2=True only for the
+        duration of the call (context-local MXUSettings override)."""
+        assert mxu_fft.current_settings().radix2 is False
         x = rng.random((256, 4, 4)).astype(np.float32)
         c = lf.rfftn_3d(x, backend="matmul-r2")
-        assert mxu_fft._RADIX2 is False
+        assert mxu_fft.current_settings().radix2 is False
         ref = np.fft.rfftn(x, axes=(0, 1, 2))
         assert _rel(np.asarray(c), ref) < 5e-4
         y = lf.irfftn_3d(c, x.shape, backend="matmul-r2")

@@ -1,0 +1,85 @@
+"""``batched`` executable — BASELINE config #4's workload ("Batched 2D FFT
+4096^2 x 64, 1D mesh") through the same testcase/Timer/eval harness as the
+3D engines. The reference has no batched-2D executable (it reaches batching
+only through cufftMakePlanMany batch counts); this CLI is the framework
+extension that makes config #4 a first-class benchmark target.
+
+Flag mapping: ``-nx``/``-ny`` are the IMAGE dimensions and ``-nz`` is the
+BATCH count, so config #4 reads naturally:
+
+    python -m distributedfft_tpu.cli.batched -nx 4096 -ny 4096 -nz 64 \
+        --shard batch -t 0 -p 8 --emulate-devices 8
+
+The Timer CSV filename slots are ``<batch>_<nx>_<ny>`` (the plan's
+``global_size`` — batch rides the first slot; the halved spectral axis ny
+rides the last, mirroring the 3D schema's halved z).
+
+``--shard batch`` (default) shards the batch axis — embarrassingly
+parallel, zero collectives; ``--shard x`` runs the slab-style decomposition
+(1D FFT y -> all_to_all transpose -> 1D FFT x) for batches too small to
+fill the mesh. ``--batch-chunk`` caps compiled-program size via sequential
+``lax.map`` chunks (how 4096^2 x 64 fits the remote-compile limits).
+
+Testcases 0-3 are supported (4 is the 3D Laplacian validation — not
+meaningful for a 2D stack).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .common import add_common_args, run_testcase, setup_backend
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="batched", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    add_common_args(ap, pencil=False, comm_tunable=False)
+    ap.add_argument("--shard", default="batch", choices=("batch", "x"),
+                    help="decomposed axis: 'batch' (no collectives) or 'x' "
+                         "(slab-style transpose pipeline)")
+    ap.add_argument("--batch-chunk", type=int, default=None,
+                    help="transform the per-device batch in sequential "
+                         "chunks of this size (lax.map) — caps compiled "
+                         "program size; must divide the local padded batch")
+    ap.add_argument("--partitions", "-p", type=int, default=0,
+                    help="mesh width (default: all devices)")
+    ap.add_argument("--c2c", action="store_true",
+                    help="complex-to-complex transform instead of R2C/C2R")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_backend(args)
+
+    import jax
+    from .. import params as pm
+    from ..models.batched2d import Batched2DFFTPlan
+
+    if args.testcase == 4:
+        print("testcase 4 (3D Laplacian) is not defined for the batched-2D "
+              "plan; use testcases 0-3", file=sys.stderr)
+        return 2
+    p = args.partitions or len(jax.devices())
+    cfg = pm.Config(
+        comm_method=pm.CommMethod.parse(args.comm_method),
+        send_method=pm.SendMethod.parse(args.send_method),
+        opt=args.opt, cuda_aware=args.cuda_aware,
+        warmup_rounds=args.warmup_rounds, iterations=args.iterations,
+        double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
+        fft_backend=args.fft_backend)
+    plan = Batched2DFFTPlan(
+        batch=args.input_dim_z, nx=args.input_dim_x, ny=args.input_dim_y,
+        partition=pm.SlabPartition(p), config=cfg, shard=args.shard,
+        transform="c2c" if args.c2c else "r2c",
+        batch_chunk=args.batch_chunk)
+    # dims=2: the roundtrip scale of an unnormalized 2D transform is nx*ny
+    # (testcases._roundtrip_scale maps dims=2 onto the last two size slots).
+    return run_testcase(plan, args, dims=2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

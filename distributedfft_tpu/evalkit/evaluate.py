@@ -52,7 +52,22 @@ _VARIANT_LABELS = {
     "slab_z_then_yx": ("Slab", "1D-2D"),
     "slab_y_then_zx": ("Slab", "1D-2D-Y"),
     "pencil": ("Pencil", ""),
+    "batched2d_batch": ("Batched2D", "batch-sharded"),
+    "batched2d_x": ("Batched2D", "x-sharded"),
 }
+
+
+def _variant_label(variant: str):
+    """Pretty (family, flavor) label; chunked batched2d variants
+    (``batched2d_<shard>_ck<N>``) derive from their base variant with the
+    chunk appended so the whole open-ended family stays labeled."""
+    if variant in _VARIANT_LABELS:
+        return _VARIANT_LABELS[variant]
+    base, sep, ck = variant.rpartition("_ck")
+    if sep and ck.isdigit() and base in _VARIANT_LABELS:
+        fam, flavor = _VARIANT_LABELS[base]
+        return fam, f"{flavor} chunk={ck}"
+    return variant, ""
 
 
 def _t_ci(values: np.ndarray, conf: float = 0.95) -> Tuple[float, float, float]:
@@ -158,7 +173,7 @@ def reduce_prefix(prefix: str, out: str, make_plots: bool = False) -> None:
     prop_plot_data: Dict[Tuple[int, int], List[Tuple]] = defaultdict(list)
 
     for variant, combos in data.items():
-        vlabel = _VARIANT_LABELS.get(variant, (variant, ""))
+        vlabel = _variant_label(variant)
         by_opc: Dict[Tuple[int, int, int], Dict] = defaultdict(dict)
         for (opt, comm, snd, cuda, p), sizes in combos.items():
             by_opc[(opt, cuda, p)][(comm, snd)] = sizes

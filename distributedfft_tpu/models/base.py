@@ -201,16 +201,21 @@ class DistFFTPlan:
 
         return jax.jit(run) if jit else run
 
-    # -- staged-execution helper (shared by slab/pencil) -------------------
+    # -- staged-execution helper (shared by slab/pencil/batched2d) ---------
 
     def _jit_stages(self, specs):
-        """Jit each (desc, body, in_spec, out_spec) as its own shard_mapped
-        program so per-phase timers can fence between them."""
-        mesh = self.mesh
-        out = []
-        for desc, fn, ispec, ospec in specs:
-            sm = jax.shard_map(fn, mesh=mesh, in_specs=ispec, out_specs=ospec)
-            out.append((desc, jax.jit(
-                sm, in_shardings=NamedSharding(mesh, ispec),
-                out_shardings=NamedSharding(mesh, ospec))))
-        return out
+        return jit_stages(self.mesh, specs)
+
+
+def jit_stages(mesh, specs):
+    """Jit each (desc, body, in_spec, out_spec) as its own shard_mapped
+    program so per-phase timers can fence between them. Module-level so
+    plans outside the DistFFTPlan hierarchy (Batched2DFFTPlan) share the
+    exact stage contract."""
+    out = []
+    for desc, fn, ispec, ospec in specs:
+        sm = jax.shard_map(fn, mesh=mesh, in_specs=ispec, out_specs=ospec)
+        out.append((desc, jax.jit(
+            sm, in_shardings=NamedSharding(mesh, ispec),
+            out_shardings=NamedSharding(mesh, ospec))))
+    return out

@@ -33,7 +33,7 @@ from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
-from .base import _with_pad
+from .base import _with_pad, jit_stages
 
 
 class Batched2DFFTPlan:
@@ -264,9 +264,10 @@ class Batched2DFFTPlan:
                                        self.output_padded_shape)
         return self._inv_pure
 
-    def _build_slab_pure(self, forward: bool):
-        """shard='x': 1D FFT y -> transpose (x-split -> y-split) -> 1D FFT x,
-        the 2D restriction of the slab ZY_Then_X pipeline."""
+    def _slab_parts(self, forward: bool):
+        """(first, xpose, last) stage closures of the shard='x' pipeline —
+        composed fused by ``_build_slab_pure``, jitted individually by
+        ``forward_stages``/``inverse_stages`` for per-phase timing."""
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
         realigned = self.config.opt == 1
@@ -275,27 +276,129 @@ class Batched2DFFTPlan:
         complex_mode = self.transform == "c2c"
 
         if forward:
-            def body(xl):  # (B, nxb, ny)
+            def first(xl):  # (B, nxb, ny)
                 if complex_mode:
                     c = lf.fft(xl, axis=2, norm=norm, backend=be, settings=st)
                 else:
                     c = lf.rfft(xl, axis=2, norm=norm, backend=be, settings=st)
-                c = pad_axis_to(c, 2, nys_pad)
-                c = all_to_all_transpose(c, SLAB_AXIS, 2, 1,
-                                         realigned=realigned)
+                return pad_axis_to(c, 2, nys_pad)
+
+            def xpose(c):
+                return all_to_all_transpose(c, SLAB_AXIS, 2, 1,
+                                            realigned=realigned)
+
+            def last(c):
                 c = slice_axis_to(c, 1, nx)
                 return lf.fft(c, axis=1, norm=norm, backend=be, settings=st)
-            in_spec, out_spec = self._in_spec, self._out_spec
         else:
-            def body(cl):  # (B, nx, nysb)
+            def first(cl):  # (B, nx, nysb)
                 c = lf.ifft(cl, axis=1, norm=norm, backend=be, settings=st)
-                c = pad_axis_to(c, 1, nx_pad)
-                c = all_to_all_transpose(c, SLAB_AXIS, 1, 2,
-                                         realigned=realigned)
+                return pad_axis_to(c, 1, nx_pad)
+
+            def xpose(c):
+                return all_to_all_transpose(c, SLAB_AXIS, 1, 2,
+                                            realigned=realigned)
+
+            def last(c):
                 c = slice_axis_to(c, 2, nys)
                 if complex_mode:
-                    return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
-                return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be, settings=st)
+                    return lf.ifft(c, axis=2, norm=norm, backend=be,
+                                   settings=st)
+                return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be,
+                                settings=st)
+        return first, xpose, last
+
+    def _build_slab_pure(self, forward: bool):
+        """shard='x': 1D FFT y -> transpose (x-split -> y-split) -> 1D FFT x,
+        the 2D restriction of the slab ZY_Then_X pipeline.
+
+        Comm-method mapping follows ``SlabFFTPlan._assemble_pure``: ALL2ALL
+        is one shard_map with the explicit collective; PEER2PEER omits it —
+        two shard_map stages whose boundary sharding change makes XLA's
+        SPMD partitioner insert and schedule the collective. (Without this
+        split the sweep's comm axis would compare two runs of the same
+        program.)"""
+        first, xpose, last = self._slab_parts(forward)
+        mesh = self.mesh
+        if forward:
+            in_spec, out_spec = self._in_spec, self._out_spec
+        else:
             in_spec, out_spec = self._out_spec, self._in_spec
-        return (jax.shard_map(body, mesh=self.mesh, in_specs=in_spec,
-                              out_specs=out_spec), in_spec, out_spec)
+        if self.config.comm_method is pm.CommMethod.ALL2ALL:
+            return (jax.shard_map(lambda v: last(xpose(first(v))), mesh=mesh,
+                                  in_specs=in_spec, out_specs=out_spec),
+                    in_spec, out_spec)
+        stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
+                               out_specs=in_spec)
+        stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
+                               out_specs=out_spec)
+        return (lambda v: stage2(stage1(v)), in_spec, out_spec)
+
+    # -- per-phase staged execution (benchmark timer support; same hooks
+    #    as the 3D engines so testcases/Timer/eval reach this plan) -------
+
+    @property
+    def global_size(self) -> pm.GlobalSize:
+        """(batch, nx, ny) mapped onto the 3-slot size schema of the Timer
+        CSV filenames and testcases. The halved spectral axis is ny (the
+        last slot), so ``nz_out`` equals ``self._ny_spec`` for r2c — the
+        batched plan is structurally the 3D schema with batch riding the
+        first slot and no transform along it."""
+        return pm.GlobalSize(self.batch, self.nx, self.ny)
+
+    @property
+    def variant_name(self) -> str:
+        """Chunked runs get their own variant directory: the reference
+        filename schema has no chunk slot, and mixing chunked/unchunked
+        blocks in one CSV would read as iterations of one config."""
+        base = f"batched2d_{self.shard}"
+        return f"{base}_ck{self.batch_chunk}" if self.batch_chunk else base
+
+    @property
+    def section_descriptions(self):
+        """Phase vocabulary: the slab transpose marker set for shard='x'
+        (same CSV columns the eval layer already reduces), one fused-2D
+        marker for the collective-free batch sharding."""
+        if self.fft3d or self.shard == "batch":
+            return ["init", "2D FFT X-Y-Direction", "Run complete",
+                    "Run complete (fused)"]
+        xpose = ["Transpose (First Send)", "Transpose (Packing)",
+                 "Transpose (Start Local Transpose)",
+                 "Transpose (Start Receive)", "Transpose (First Receive)",
+                 "Transpose (Finished Receive)", "Transpose (Start All2All)",
+                 "Transpose (Finished All2All)", "Transpose (Unpacking)"]
+        return ["init", "1D FFT Y-Direction"] + xpose + [
+            "1D FFT X-Direction", "Run complete", "Run complete (fused)"]
+
+    def _xpose_desc(self) -> str:
+        return ("Transpose (Finished All2All)"
+                if self.config.comm_method is pm.CommMethod.ALL2ALL
+                else "Transpose (Finished Receive)")
+
+    def _jit_stages(self, specs):
+        return jit_stages(self.mesh, specs)
+
+    def forward_stages(self):
+        """[(phase desc, jitted stage fn)] for per-phase timed execution
+        (slab contract). Batch sharding has no collective, so its staged
+        path IS the fused program under one descriptive marker."""
+        if self.fft3d or self.shard == "batch":
+            if self._fwd is None:
+                self._fwd = self._build(forward=True)
+            return [("2D FFT X-Y-Direction", self._fwd)]
+        first, xpose, last = self._slab_parts(True)
+        return self._jit_stages(
+            [("1D FFT Y-Direction", first, self._in_spec, self._in_spec),
+             (self._xpose_desc(), xpose, self._in_spec, self._out_spec),
+             ("1D FFT X-Direction", last, self._out_spec, self._out_spec)])
+
+    def inverse_stages(self):
+        if self.fft3d or self.shard == "batch":
+            if self._inv is None:
+                self._inv = self._build(forward=False)
+            return [("2D FFT X-Y-Direction", self._inv)]
+        first, xpose, last = self._slab_parts(False)
+        return self._jit_stages(
+            [("1D FFT X-Direction", first, self._out_spec, self._out_spec),
+             (self._xpose_desc(), xpose, self._out_spec, self._in_spec),
+             ("1D FFT Y-Direction", last, self._in_spec, self._in_spec)])

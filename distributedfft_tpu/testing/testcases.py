@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import params as pm
+from ..models.batched2d import Batched2DFFTPlan
 from ..models.pencil import PencilFFTPlan
 from ..models.slab import SlabFFTPlan
 from ..utils.timer import Timer, benchmark_filename
@@ -64,11 +65,17 @@ def make_timer(plan, write_csv: bool = True) -> Timer:
                                       pencil_grid=grid)
     import jax
     return Timer(plan.section_descriptions, plan.partition.num_ranks, filename,
-                 process_index=jax.process_index())
+                 process_index=jax.process_index(),
+                 num_processes=jax.process_count())
 
 
 def reference_spectrum(plan, x: np.ndarray, dims: int = 3) -> np.ndarray:
     """Single-host ground truth in the plan's own spectral layout."""
+    if isinstance(plan, Batched2DFFTPlan):
+        # Batched 2D: transform over (x, y) = axes (1, 2), batch untouched.
+        if plan.transform == "c2c":
+            return np.fft.fft(np.fft.fft(x, axis=2), axis=1)
+        return np.fft.fft(np.fft.rfft(x, axis=2), axis=1)
     if isinstance(plan, SlabFFTPlan) and plan.sequence is pm.SlabSequence.Y_THEN_ZX:
         r = np.fft.rfft(x, axis=1)
         r = np.fft.fft(r, axis=2)
@@ -82,8 +89,10 @@ def reference_spectrum(plan, x: np.ndarray, dims: int = 3) -> np.ndarray:
 
 
 def _stages(plan, direction: str, dims: int = 3):
-    """Stage list for either plan kind; pencil takes the partial-dim depth
-    (reference --fft-dim), slab ignores it (always full 3D)."""
+    """Stage list for any plan kind; pencil takes the partial-dim depth
+    (reference --fft-dim), slab and batched2d ignore it (slab is always
+    full 3D, batched2d always a 2D transform — its callers pass dims=2
+    so the roundtrip scale comes out nx*ny)."""
     if isinstance(plan, PencilFFTPlan):
         return (plan.forward_stages(dims) if direction == "fwd"
                 else plan.inverse_stages(dims))
@@ -113,6 +122,9 @@ def _fused_fns(plan, dims: int = 3):
     stages with fences between them (extra dispatch, no cross-stage
     overlap), so its "Run complete" overstates the production runtime; the
     reference times its actual hot path (mpicufft_slab.cpp:772-821)."""
+    if isinstance(plan, Batched2DFFTPlan):
+        # exec_forward/exec_inverse carry both r2c and c2c modes.
+        return plan.exec_forward, plan.exec_inverse
     if getattr(plan, "transform", "r2c") == "c2c":
         if isinstance(plan, PencilFFTPlan):
             return (lambda v: plan.exec_c2c(v, dims),

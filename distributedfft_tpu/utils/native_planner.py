@@ -66,6 +66,13 @@ def _lib() -> Optional[ctypes.CDLL]:
             lib.dfft_timer_csv_append.restype = ctypes.c_int
         except AttributeError:
             pass
+        try:
+            lib.dfft_timer_csv_append_cols.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_double), i64, i64]
+            lib.dfft_timer_csv_append_cols.restype = ctypes.c_int
+        except AttributeError:
+            pass
         _LIB = lib
         break
     return _LIB
@@ -166,6 +173,28 @@ def timer_csv_append(path: str, durations, pcnt: int) -> Optional[bool]:
     descs = (ctypes.c_char_p * n)(*[d.encode() for d, _ in items])
     vals = (ctypes.c_double * n)(*[float(v) for _, v in items])
     rc = lib.dfft_timer_csv_append(path.encode(), descs, vals, n, pcnt)
+    if rc == 0:
+        return True
+    return None if rc in (1, 2) else False
+
+
+def timer_csv_append_cols(path: str, rows, pcnt: int) -> Optional[bool]:
+    """Per-rank-column variant of ``timer_csv_append``: ``rows`` is an
+    ordered (desc, [v_0, ..., v_{pcnt-1}]) sequence — the multi-controller
+    Timer path, where each rank column carries its owning process's
+    measured value. Same return contract."""
+    lib = _lib()
+    if lib is None or not hasattr(lib, "dfft_timer_csv_append_cols"):
+        return None
+    items = [(d, list(vs)) for d, vs in rows]
+    n = len(items)
+    for _, vs in items:
+        if len(vs) != pcnt:
+            raise ValueError(f"each row needs {pcnt} values, got {len(vs)}")
+    descs = (ctypes.c_char_p * n)(*[d.encode() for d, _ in items])
+    flat = [float(v) for _, vs in items for v in vs]
+    vals = (ctypes.c_double * (n * pcnt))(*flat)
+    rc = lib.dfft_timer_csv_append_cols(path.encode(), descs, vals, n, pcnt)
     if rc == 0:
         return True
     return None if rc in (1, 2) else False

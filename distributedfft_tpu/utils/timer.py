@@ -12,9 +12,15 @@ reconstruct per-phase breakdowns.
 
 The TPU framework is single-controller SPMD: phases are global program
 stages fenced with ``jax.block_until_ready``, so one host-side measurement
-describes all shards. To keep the CSV schema (and the eval scripts) working,
-the per-rank columns replicate that global value. Under multi-host
-``jax.distributed`` runs, only process 0 writes.
+describes all shards and the per-rank columns replicate that global value.
+Under multi-controller (``jax.distributed``) runs, every process measures
+its own host-side durations for the same global stages; ``gather()``
+allgathers the per-process duration vectors (the reference's
+``Timer::gather`` MPI-gather, ``src/timer.cpp:58-102``) and writes GENUINE
+per-rank columns — each rank column carries the value measured by the
+process owning that device — so per-host skew (dispatch delays, stragglers)
+is visible in the CSV. Only process 0 writes; the allgather itself is a
+collective every process must reach.
 """
 
 from __future__ import annotations
@@ -57,15 +63,24 @@ def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
 
 class Timer:
     """Phase timer: ``start()`` -> ``stop_store(desc)`` markers ->
-    ``gather()`` appends one CSV block."""
+    ``gather()`` appends one CSV block.
+
+    ``num_processes`` > 1 switches ``gather()`` to the multi-controller
+    path: an allgather of every process's duration vector, then per-rank
+    columns mapped process -> owned devices. ``allgather_fn`` overrides
+    the collective (tests inject a fake; default is
+    ``jax.experimental.multihost_utils.process_allgather``)."""
 
     def __init__(self, descs: Sequence[str], pcnt: int, filename: Optional[str],
-                 process_index: int = 0, gather_process: int = 0):
+                 process_index: int = 0, gather_process: int = 0,
+                 num_processes: int = 1, allgather_fn=None):
         self.descs = list(descs)
         self.pcnt = pcnt
         self.filename = filename
         self.process_index = process_index
         self.gather_process = gather_process
+        self.num_processes = num_processes
+        self.allgather_fn = allgather_fn
         self._tstart = 0.0
         self._durations: Dict[str, float] = {}
 
@@ -86,19 +101,56 @@ class Timer:
     def durations(self) -> Dict[str, float]:
         return dict(self._durations)
 
+    def _rank_columns(self):
+        """Multi-controller: allgather every process's duration vector and
+        map each rank column to its owning process (contiguous blocks in
+        device order — how jax lays processes over a pod). This is a
+        COLLECTIVE: every process must reach it, so ``gather()`` calls it
+        before the only-process-0-writes early-return."""
+        values = [self._durations.get(d, 0.0) for d in self.descs]
+        fn = self.allgather_fn
+        if fn is None:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            def fn(v):
+                return multihost_utils.process_allgather(np.asarray(v))
+        import numpy as np
+        mat = np.asarray(fn(np.asarray(values, dtype=np.float64)))
+        if mat.shape != (self.num_processes, len(values)):
+            raise ValueError(
+                f"allgather returned shape {mat.shape}, expected "
+                f"{(self.num_processes, len(values))}")
+        return [[float(mat[r * self.num_processes // self.pcnt][s])
+                 for r in range(self.pcnt)]
+                for s in range(len(values))]
+
     def gather(self) -> None:
         """Append one CSV block (header once, then a blank-prefixed block of
         ``desc,v0,...,v{P-1},`` rows). Unvisited sections report 0, like the
         reference's never-stopped sections. The append itself runs in the
         native timer (``native/timer.cpp``, the reference ``src/timer.cpp``
         analog) when ``libdfft_planner.so`` is built, with this Python
-        writer as byte-identical fallback."""
+        writer as byte-identical fallback.
+
+        Single-controller: columns replicate this process's value.
+        Multi-controller: per-process vectors are allgathered first (a
+        collective — reached by every process regardless of who writes),
+        then each rank column gets its owning process's measurement."""
+        cols = None
+        if self.num_processes > 1:
+            cols = self._rank_columns()
         if self.filename is None or self.process_index != self.gather_process:
             return
         os.makedirs(os.path.dirname(self.filename), exist_ok=True)
-        ordered = [(d, self._durations.get(d, 0.0)) for d in self.descs]
-        wrote = native_planner.timer_csv_append(self.filename, ordered,
-                                                self.pcnt)
+        if cols is None:
+            ordered = [(d, self._durations.get(d, 0.0)) for d in self.descs]
+            wrote = native_planner.timer_csv_append(self.filename, ordered,
+                                                    self.pcnt)
+        else:
+            rows = list(zip(self.descs, cols))
+            wrote = native_planner.timer_csv_append_cols(self.filename, rows,
+                                                         self.pcnt)
         if wrote:
             return
         if wrote is False:
@@ -119,9 +171,12 @@ class Timer:
             if fresh:
                 f.write("," + ",".join(str(i) for i in range(self.pcnt)) + ",")
             f.write("\n")
-            for desc in self.descs:
-                v = self._durations.get(desc, 0.0)
-                row = ",".join(repr(v) for _ in range(self.pcnt))
+            for i, desc in enumerate(self.descs):
+                if cols is None:
+                    v = self._durations.get(desc, 0.0)
+                    row = ",".join(repr(v) for _ in range(self.pcnt))
+                else:
+                    row = ",".join(repr(v) for v in cols[i])
                 f.write(f"{desc},{row},\n")
 
 

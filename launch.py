@@ -27,9 +27,10 @@ import sys
 from typing import Dict, List
 
 
-EXES = {"slab": "distributedfft_tpu.cli.slab",
+EXES = {"batched": "distributedfft_tpu.cli.batched",
         "pencil": "distributedfft_tpu.cli.pencil",
-        "reference": "distributedfft_tpu.cli.reference"}
+        "reference": "distributedfft_tpu.cli.reference",
+        "slab": "distributedfft_tpu.cli.slab"}
 
 
 def exe_for_test(test: Dict) -> str:

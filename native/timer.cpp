@@ -85,9 +85,14 @@ extern "C" {
 // Returns 0 on success; 1 on argument error and 2 when the file cannot be
 // opened (nothing written — the caller may safely fall back); 3 on a write
 // error (file state unknown — the caller must NOT write a fallback block).
-int dfft_timer_csv_append(const char *path, const char *const *descs,
-                          const double *values, int64_t n_descs,
-                          int64_t pcnt) {
+namespace {
+
+// Shared body of both entry points; `stride` selects the value layout:
+// 0 = one value per section replicated across rank columns, pcnt =
+// row-major [n_descs][pcnt] with a distinct value per column.
+int append_block(const char *path, const char *const *descs,
+                 const double *values, int64_t n_descs, int64_t pcnt,
+                 int64_t stride) {
     if (path == nullptr || descs == nullptr || values == nullptr ||
         n_descs < 0 || pcnt <= 0)
         return 1;
@@ -104,10 +109,10 @@ int dfft_timer_csv_append(const char *path, const char *const *descs,
     char buf[64];
     for (int64_t s = 0; s < n_descs; ++s) {
         if (descs[s] == nullptr) return 1;
-        format_repr(values[s], buf, sizeof buf);
         block += descs[s];
         block += ',';
         for (int64_t i = 0; i < pcnt; ++i) {
+            format_repr(values[stride ? s * stride + i : s], buf, sizeof buf);
             block += buf;
             block += ',';
         }
@@ -118,6 +123,26 @@ int dfft_timer_csv_append(const char *path, const char *const *descs,
     const size_t put = std::fwrite(block.data(), 1, block.size(), f);
     const int close_err = std::fclose(f);
     return (put == block.size() && close_err == 0) ? 0 : 3;
+}
+
+}  // namespace
+
+int dfft_timer_csv_append(const char *path, const char *const *descs,
+                          const double *values, int64_t n_descs,
+                          int64_t pcnt) {
+    return append_block(path, descs, values, n_descs, pcnt, /*stride=*/0);
+}
+
+// Per-rank-column variant: `values` is row-major [n_descs][pcnt] and each
+// rank column gets its own value — the multi-controller path, where the
+// per-process duration vectors are allgathered (the reference's
+// Timer::gather MPI_Gather, src/timer.cpp:58-102) and per-host skew must
+// be visible in the CSV instead of process 0's value replicated. Same
+// return contract as dfft_timer_csv_append.
+int dfft_timer_csv_append_cols(const char *path, const char *const *descs,
+                               const double *values, int64_t n_descs,
+                               int64_t pcnt) {
+    return append_block(path, descs, values, n_descs, pcnt, /*stride=*/pcnt);
 }
 
 }  // extern "C"

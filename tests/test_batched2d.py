@@ -100,3 +100,96 @@ class TestBatchChunk:
                              shard="x", batch_chunk=2)
         with pytest.raises(ValueError, match="positive"):
             Batched2DFFTPlan(8, 16, 16, SlabPartition(1), batch_chunk=0)
+
+
+class TestHarnessWiring:
+    """VERDICT r2 item 7: the batched plan flows through the same
+    testcase/Timer/eval harness as the 3D engines (variant_name,
+    section_descriptions, staged execution, CLI, job specs)."""
+
+    def _plan(self, shard, **kw):
+        return Batched2DFFTPlan(8, 24, 16, SlabPartition(8),
+                                Config(double_prec=True), shard=shard, **kw)
+
+    @pytest.mark.parametrize("shard", ["batch", "x"])
+    def test_staged_matches_fused(self, devices, rng, shard):
+        plan = self._plan(shard)
+        x = plan.pad_input(rng.random((8, 24, 16)))
+        y = x
+        for _, fn in plan.forward_stages():
+            y = fn(y)
+        fused = plan.exec_forward(x)
+        np.testing.assert_allclose(np.asarray(plan.crop_spectral(y)),
+                                   np.asarray(plan.crop_spectral(fused)),
+                                   atol=1e-10)
+        z = y
+        for _, fn in plan.inverse_stages():
+            z = fn(z)
+        np.testing.assert_allclose(np.asarray(plan.crop_real(z)),
+                                   rng_scale := np.asarray(
+                                       plan.crop_real(plan.exec_inverse(y))),
+                                   atol=1e-8)
+        assert rng_scale.shape == (8, 24, 16)
+
+    @pytest.mark.parametrize("shard", ["batch", "x"])
+    def test_stage_descs_subset_of_sections(self, devices, shard):
+        plan = self._plan(shard)
+        descs = {d for d, _ in plan.forward_stages()} | \
+                {d for d, _ in plan.inverse_stages()}
+        assert descs <= set(plan.section_descriptions)
+        assert "Run complete" in plan.section_descriptions
+        assert plan.variant_name == f"batched2d_{shard}"
+        assert plan.global_size.shape == (8, 24, 16)
+
+    @pytest.mark.parametrize("shard", ["batch", "x"])
+    def test_testcases_0_to_3(self, devices, tmp_path, shard, monkeypatch):
+        from distributedfft_tpu.testing import testcases as tc
+        monkeypatch.chdir(tmp_path)
+        plan = self._plan(shard)
+        # shared Timer CSV exercised via write_csv=True (lands in tmp cwd)
+        r0 = tc.testcase0(plan, iterations=2, warmup=1, dims=2)
+        assert r0["mean_ms"] > 0 and r0["fused_mean_ms"] > 0
+        r1 = tc.testcase1(plan, dims=2, write_csv=False)
+        assert r1["residual_sum"] < 1e-6
+        r2 = tc.testcase2(plan, iterations=1, dims=2, write_csv=False)
+        assert r2["mean_ms"] > 0
+        r3 = tc.testcase3(plan, iterations=1, dims=2, write_csv=False)
+        assert r3["max_error"] < 1e-8  # f64 roundtrip vs nx*ny-scaled input
+        # the CSV went under the batched variant dir with slab-schema name
+        from distributedfft_tpu.utils.timer import read_timer_csv
+        csvs = list((tmp_path / "benchmarks"
+                     / f"batched2d_{shard}").glob("test_*.csv"))
+        assert len(csvs) == 1
+        blocks = read_timer_csv(str(csvs[0]))
+        assert len(blocks) == 2  # testcase0's two gathered iterations
+        assert "Run complete" in blocks[0]
+
+    def test_cli_main_runs_testcase3(self, tmp_path, monkeypatch):
+        from distributedfft_tpu.cli import batched
+        monkeypatch.chdir(tmp_path)
+        rc = batched.main(["-nx", "24", "-ny", "16", "-nz", "8",
+                           "--shard", "batch", "-t", "3", "-d",
+                           "--emulate-devices", "8"])
+        assert rc == 0
+
+    def test_cli_rejects_testcase4(self, tmp_path, monkeypatch):
+        from distributedfft_tpu.cli import batched
+        monkeypatch.chdir(tmp_path)
+        rc = batched.main(["-nx", "8", "-ny", "8", "-nz", "4", "-t", "4",
+                           "--emulate-devices", "8"])
+        assert rc == 2
+
+
+def test_x_shard_peer2peer_roundtrip(devices, rng):
+    """PEER2PEER builds a genuinely different program (no explicit
+    collective; GSPMD inserts it at the stage boundary) — it must still
+    compute the same transform."""
+    from distributedfft_tpu import CommMethod
+    plan = Batched2DFFTPlan(4, 32, 32, SlabPartition(8),
+                            Config(comm_method=CommMethod.PEER2PEER,
+                                   double_prec=True), shard="x")
+    x = rng.random((4, 32, 32))
+    c = plan.exec_forward(x)
+    np.testing.assert_allclose(plan.crop_spectral(c), ref2d(x), atol=1e-9)
+    r = plan.crop_real(plan.exec_inverse(c))
+    np.testing.assert_allclose(r, x * 32 * 32, atol=1e-8)

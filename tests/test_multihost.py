@@ -127,6 +127,48 @@ def test_two_process_mesh_end_to_end(tmp_path):
         assert f"OK {i}/2" in out
 
 
+_TIMER_WORKER = textwrap.dedent("""
+    import time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    from distributedfft_tpu.parallel import multihost as mh
+    pid, cnt = mh.maybe_initialize()
+    assert cnt == 2, (pid, cnt)
+    from distributedfft_tpu.utils.timer import Timer, read_timer_csv
+    csv = CSV_PATH
+    t = Timer(["phase A", "Run complete"], pcnt=8, filename=csv,
+              process_index=pid, num_processes=cnt)
+    t.start()
+    time.sleep(0.05 * (pid + 1))   # deliberate per-process skew
+    t.stop_store("phase A")
+    t.stop_store("Run complete")
+    t.gather()                     # collective: both processes reach it
+    if pid == 0:
+        row = read_timer_csv(csv)[0]["phase A"]
+        assert len(row) == 8, row
+        # ranks 0-3 carry process 0's measurement, ranks 4-7 process 1's;
+        # the designed ~50 ms skew must be visible across the boundary and
+        # invisible within each process's block.
+        assert row[0] == row[3] and row[4] == row[7], row
+        assert row[4] - row[0] > 20.0, row
+    print(f"TIMER OK {pid}", flush=True)
+    mh.shutdown()
+""")
+
+
+def test_two_process_timer_gathers_per_process_columns(tmp_path):
+    """VERDICT r2 item 6: under multi-controller runs the Timer CSV must
+    carry each process's OWN durations in its ranks' columns (the
+    reference Timer::gather MPI-gather analog), not process 0's value
+    replicated — per-host skew is the thing the columns exist to expose."""
+    csv = str(tmp_path / "bench" / "timer.csv")
+    script = _TIMER_WORKER.replace("CSV_PATH", repr(csv))
+    outs = _run_two_procs(tmp_path, script)
+    for i, out in enumerate(outs):
+        assert f"TIMER OK {i}" in out
+
+
 _AUTOTUNE_WORKER = textwrap.dedent("""
     import jax
     jax.config.update("jax_platforms", "cpu")

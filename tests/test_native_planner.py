@@ -126,6 +126,43 @@ class TestNativeTimerCSV:
         t.gather()  # silent no-op, not a crash
         assert "a" in t.durations()
 
+    def test_cols_variant_byte_identical_to_python(self, tmp_path,
+                                                   monkeypatch):
+        """The per-rank-column writer (multi-controller Timer path) must be
+        byte-identical between native and the Python fallback, and really
+        write DISTINCT columns."""
+        from distributedfft_tpu.utils import timer as timer_mod
+
+        def fake_allgather(v):
+            import numpy as np
+            base = np.asarray(v, dtype=np.float64)
+            return np.stack([base, base + 1.0])  # 2 "processes"
+
+        def run(name, native):
+            path = tmp_path / name
+            if not native:
+                monkeypatch.setattr(timer_mod.native_planner,
+                                    "timer_csv_append_cols",
+                                    lambda *a, **k: None)
+            t = timer_mod.Timer([d for d, _ in self.DURATIONS], pcnt=4,
+                                filename=str(path), num_processes=2,
+                                allgather_fn=fake_allgather)
+            for _ in range(2):
+                t.start()
+                t._durations = dict(self.DURATIONS)
+                t.gather()
+            monkeypatch.undo()
+            return path.read_bytes()
+
+        nat = run("native_cols.csv", True)
+        py = run("py_cols.csv", False)
+        assert nat == py
+        from distributedfft_tpu.utils.timer import read_timer_csv
+        blocks = read_timer_csv(str(tmp_path / "native_cols.csv"))
+        # ranks 0-1 belong to fake process 0, ranks 2-3 to process 1
+        row = blocks[0]["Run complete"]
+        assert row == [42.0, 42.0, 43.0, 43.0]
+
     def test_locale_independent(self, tmp_path, monkeypatch):
         """The native writer must emit '.' decimals even under a locale
         whose separator is ',' (the CSV delimiter)."""

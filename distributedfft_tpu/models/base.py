@@ -168,12 +168,43 @@ class DistFFTPlan:
 
     # -- single-device fallback ------------------------------------------
 
+    def _chunk_for(self, nx: int):
+        """Validated ``Config.fft3d_chunk`` for a leading extent of
+        ``nx`` (None = fused path)."""
+        ck = self.config.fft3d_chunk
+        if not ck or ck <= 1:
+            return None
+        if nx % ck:
+            raise ValueError(f"fft3d_chunk {ck} must divide the x extent "
+                             f"{nx}")
+        return ck
+
     def _fft3d_r2c(self, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
+        ck = self._chunk_for(self.input_shape[0])
 
         def run(x):
-            return local_fft.rfftn_3d(x, norm=norm, backend=be, settings=st)
+            if ck is None:
+                return local_fft.rfftn_3d(x, norm=norm, backend=be,
+                                          settings=st)
+            # Memory-bounded large-cube path: z+y stages per leading-axis
+            # chunk (lax.map serializes them, capping the four-step
+            # relayout temporaries at chunk size); the x stage needs the
+            # full axis and runs on the already-halved spectrum.
+            nx = x.shape[0]
+
+            def per(xs):
+                c = local_fft.rfft(xs, axis=-1, norm=norm, backend=be,
+                                   settings=st)
+                return local_fft.fft(c, axis=-2, norm=norm, backend=be,
+                                     settings=st)
+
+            cs = jnp.reshape(x, (ck, nx // ck) + x.shape[1:])
+            c = jnp.reshape(jax.lax.map(per, cs),
+                            (nx,) + x.shape[1:-1] + (x.shape[-1] // 2 + 1,))
+            return local_fft.fft(c, axis=-3, norm=norm, backend=be,
+                                 settings=st)
 
         return jax.jit(run) if jit else run
 
@@ -181,9 +212,25 @@ class DistFFTPlan:
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
         shape = self.input_shape
+        ck = self._chunk_for(shape[0])
 
         def run(c):
-            return local_fft.irfftn_3d(c, shape, norm=norm, backend=be, settings=st)
+            if ck is None:
+                return local_fft.irfftn_3d(c, shape, norm=norm, backend=be,
+                                           settings=st)
+            nz = shape[-1]
+            c = local_fft.ifft(c, axis=-3, norm=norm, backend=be,
+                               settings=st)
+
+            def per(cs):
+                y = local_fft.ifft(cs, axis=-2, norm=norm, backend=be,
+                                   settings=st)
+                return local_fft.irfft(y, n=nz, axis=-1, norm=norm,
+                                       backend=be, settings=st)
+
+            nx = c.shape[0]
+            ys = jnp.reshape(c, (ck, nx // ck) + c.shape[1:])
+            return jnp.reshape(jax.lax.map(per, ys), (nx,) + shape[1:])
 
         return jax.jit(run) if jit else run
 

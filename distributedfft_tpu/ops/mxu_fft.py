@@ -79,23 +79,30 @@ class MXUSettings:
     * ``karatsuba`` — 3-matmul complex multiply (see ``_matmul_F``).
     * ``fourstep_einsum`` — relayout-free four-step (see
       ``_fourstep_einsum``).
+    * ``direct_max`` — largest length transformed by one direct DFT
+      matmul before the four-step split kicks in (default the module
+      ``DIRECT_MAX``). Lowering it forces a four-step factorization of
+      lengths that would otherwise run direct — the knob behind the
+      512-direct vs 256x2-four-step efficiency comparison.
     """
 
     precision: lax.Precision = lax.Precision.HIGH
     radix2: bool = False
     karatsuba: bool = False
     fourstep_einsum: bool = False
+    direct_max: int = DIRECT_MAX
 
     @classmethod
     def make(cls, precision=None, radix2: bool = False,
-             karatsuba: bool = False,
-             fourstep_einsum: bool = False) -> "MXUSettings":
+             karatsuba: bool = False, fourstep_einsum: bool = False,
+             direct_max: Optional[int] = None) -> "MXUSettings":
         """Build from loosely-typed values (precision may be a string
         name in any case, a ``lax.Precision``, or None for the HIGH
         default)."""
         p = lax.Precision.HIGH if precision is None else as_precision(
             precision)
-        return cls(p, bool(radix2), bool(karatsuba), bool(fourstep_einsum))
+        return cls(p, bool(radix2), bool(karatsuba), bool(fourstep_einsum),
+                   DIRECT_MAX if direct_max is None else int(direct_max))
 
 
 def as_precision(p) -> lax.Precision:
@@ -376,12 +383,12 @@ def _fft_last(x, inverse: bool):
     st = current_settings()
     if st.radix2 and n > _R2_BASE and n % 2 == 0:
         return _fft_radix2(x, inverse)
-    if n <= DIRECT_MAX:
+    if n <= st.direct_max:
         return _matmul_F(x, _dft_np(n, inverse, dbl))
     n1, n2 = _split(n)
     if n1 == 1:  # prime length: direct full-size matmul
         return _matmul_F(x, _dft_np(n, inverse, dbl))
-    if st.fourstep_einsum and n1 <= DIRECT_MAX and n2 <= DIRECT_MAX:
+    if st.fourstep_einsum and n1 <= st.direct_max and n2 <= st.direct_max:
         return _fourstep_einsum(x.reshape(x.shape[:-1] + (n2, n1)),
                                 inverse, n1, n2, dbl)
     # x[..., s*n1 + r] -> A[..., r, s]
@@ -398,19 +405,19 @@ def _rfft_last(x):
     n = x.shape[-1]
     n_out = n // 2 + 1
     dbl = _is_double(x.dtype)
-    if n <= DIRECT_MAX:
+    st = current_settings()
+    if n <= st.direct_max:
         return _rmatmul_F(x, _dft_np(n, False, dbl)[:, :n_out])
     n1, n2 = _split(n)
     if n1 == 1:
         return _rmatmul_F(x, _dft_np(n, False, dbl)[:, :n_out])
-    if current_settings().fourstep_einsum and n1 <= DIRECT_MAX \
-            and n2 <= DIRECT_MAX:
+    if st.fourstep_einsum and n1 <= st.direct_max and n2 <= st.direct_max:
         full = _fourstep_einsum(x.reshape(x.shape[:-1] + (n2, n1)),
                                 False, n1, n2, dbl)
         return full[..., :n_out]
     a = jnp.swapaxes(x.reshape(x.shape[:-1] + (n2, n1)), -1, -2)
     # First stage on real data: real matmul pair.
-    if n2 <= DIRECT_MAX:
+    if n2 <= st.direct_max:
         b = _rmatmul_F(a, _dft_np(n2, False, dbl))
     else:
         cdt = np.complex128 if dbl else np.complex64
@@ -510,7 +517,7 @@ def irfft(x, n: int, axis: int, norm: FFTNorm = FFTNorm.NONE):
     # jnp.fft.irfft contract: the spectral axis is cropped/zero-padded to
     # n//2+1 before inversion.
     c = _fit_axis(c, -1, n // 2 + 1)
-    if n <= DIRECT_MAX:
+    if n <= current_settings().direct_max:
         dbl = _is_double(c.dtype)
         CR, CI = _c2r_np(n, dbl)
         prec = _prec_for(c.dtype)

@@ -266,6 +266,15 @@ class Config:
     analog of the reference's cuFFT-plan choice at L0
     (``include/cufft.hpp:23-61``).
 
+    ``fft3d_chunk`` bounds the SINGLE-DEVICE 3D path's peak memory: the
+    z+y stages run as ``lax.map`` over that many leading-axis chunks, so
+    the four-step relayout temporaries scale with a chunk instead of the
+    whole cube (a 1024^3 f32 R2C's full-cube z-stage temporaries exceed a
+    16 GB chip; chunked they fit). Must divide the x extent; the x stage
+    (which needs the full axis) runs unchunked on the halved spectrum.
+    None (default) = fused, no chunking. R2C/C2R only; ignored by
+    distributed plans (shard the cube instead).
+
     ``mxu_precision`` / ``mxu_karatsuba`` / ``mxu_fourstep_einsum`` are the
     matmul-family backend knobs as PLAN state (read at trace time through a
     context-scoped ``mxu_fft.MXUSettings``, so two plans with different
@@ -292,6 +301,7 @@ class Config:
     mxu_precision: Optional[str] = None
     mxu_karatsuba: Optional[bool] = None
     mxu_fourstep_einsum: Optional[bool] = None
+    fft3d_chunk: Optional[int] = None
 
     def __post_init__(self):
         from .ops.fft import validate_backend  # lazy: ops.fft imports params
@@ -301,6 +311,11 @@ class Config:
             raise ValueError(
                 f"mxu_precision must be one of {sorted(_MXU_PRECISIONS)} "
                 f"or None, got {self.mxu_precision!r}")
+        if self.fft3d_chunk is not None and (
+                not isinstance(self.fft3d_chunk, int) or self.fft3d_chunk < 1):
+            raise ValueError(
+                f"fft3d_chunk must be a positive int or None, "
+                f"got {self.fft3d_chunk!r}")
 
     def mxu_settings(self):
         """The plan's ``mxu_fft.MXUSettings``, or None when every knob is

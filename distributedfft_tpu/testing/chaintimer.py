@@ -61,6 +61,123 @@ def roundtrip_chain(k: int, shape, backend: str, settings=None):
     return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
 
 
+def directional_chain(k: int, shape, backend: str, direction: str,
+                      settings=None, dtype=None):
+    """Jitted scalar-fenced chain of ``k`` SINGLE-DIRECTION transforms
+    (``direction`` in {"forward", "inverse", "roundtrip"}) with the input
+    generated ON DEVICE — no host transfer, so north-star sizes (1024^3 is
+    a 4 GiB cube; the tunnel moves ~340 MB/s) are timeable.
+
+    Chaining trick for the one-way directions: the loop carry is a scalar
+    accumulator folded into the next iteration's input as ``+ acc*1e-30``
+    — numerically negligible (operands are O(1)..O(N^3), the perturbation
+    stays ~1e-20) but a real data dependency, so XLA cannot hoist or
+    parallelize the iterations. For "inverse" the spectral input is built
+    by ONE forward transform outside the loop; like input generation, it
+    runs once per call and cancels in the (t_K - t_1) pair difference.
+
+    Returns a jitted ``fn(seed) -> scalar``; call with an int (the rng
+    seed). Callers time it exactly like ``roundtrip_chain``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import fft as lf
+
+    if direction not in ("forward", "inverse", "roundtrip"):
+        raise ValueError(f"direction must be forward/inverse/roundtrip, "
+                         f"got {direction!r}")
+    rdt = jnp.float32 if dtype is None else jnp.dtype(dtype)
+    scale = 1.0 / float(np.prod(shape))
+    tiny = 1e-30
+
+    def run(seed):
+        u = jax.random.uniform(jax.random.key(seed), tuple(shape), rdt)
+        if direction == "forward":
+            def body(i, acc):
+                c = lf.rfftn_3d(u + acc * tiny, norm=FFTNorm.NONE,
+                                backend=backend, settings=settings)
+                return acc + jnp.real(c)[0, 0, 0] * scale
+            return lax.fori_loop(0, k, body, jnp.zeros((), rdt))
+        if direction == "inverse":
+            c0 = lf.rfftn_3d(u, norm=FFTNorm.NONE, backend=backend,
+                             settings=settings)
+            def body(i, acc):
+                y = lf.irfftn_3d(c0 + acc * tiny, tuple(shape),
+                                 norm=FFTNorm.NONE, backend=backend,
+                                 settings=settings)
+                return acc + y[0, 0, 0] * scale
+            return lax.fori_loop(0, k, body, jnp.zeros((), rdt))
+
+        def body(i, v):
+            c = lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend,
+                            settings=settings)
+            return lf.irfftn_3d(c, tuple(shape), norm=FFTNorm.NONE,
+                                backend=backend, settings=settings) * scale
+        return jnp.sum(jnp.abs(lax.fori_loop(0, k, body, u)))
+
+    return jax.jit(run)
+
+
+STAGES = ("rfft_z", "fft_y", "fft_x", "ifft_x", "ifft_y", "irfft_z")
+
+
+def stage_chain(k: int, shape, backend: str, stage: str, settings=None):
+    """Jitted scalar-fenced chain of ``k`` SINGLE-AXIS transforms — one
+    stage of the 3D R2C/C2R pipeline in isolation, on exactly the shapes
+    the full pipeline feeds it. The per-stage attribution tool behind the
+    512^3 efficiency breakdown (VERDICT r2 weak#2): chain each of the six
+    stages, compare their sum against the fused roundtrip.
+
+    ``stage``: ``rfft_z`` times the real->halved-complex first stage on a
+    real cube; the complex stages (``fft_y``/``fft_x``/inverses) operate
+    on the halved cube built by ONE on-device forward outside the loop
+    (cancels in the pair difference); ``irfft_z`` times the final
+    halved-complex->real stage. Same accumulator-perturbation chaining as
+    ``directional_chain``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import fft as lf
+
+    if stage not in STAGES:
+        raise ValueError(f"stage must be one of {STAGES}, got {stage!r}")
+    nz = shape[-1]
+    scale = 1.0 / float(np.prod(shape))
+    tiny = 1e-30
+
+    def run(seed):
+        u = jax.random.uniform(jax.random.key(seed), tuple(shape),
+                               jnp.float32)
+        if stage == "rfft_z":
+            def body(i, acc):
+                c = lf.rfft(u + acc * tiny, axis=-1, backend=backend,
+                            settings=settings)
+                return acc + jnp.real(c)[0, 0, 0] * scale
+            return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
+        c0 = lf.rfft(u, axis=-1, backend=backend, settings=settings)
+        if stage == "irfft_z":
+            def body(i, acc):
+                y = lf.irfft(c0 + acc * tiny, n=nz, axis=-1,
+                             backend=backend, settings=settings)
+                return acc + y[0, 0, 0] * scale
+            return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
+        axis = -2 if stage in ("fft_y", "ifft_y") else -3
+        fwd = stage.startswith("fft")
+
+        def body(i, acc):
+            op = lf.fft if fwd else lf.ifft
+            y = op(c0 + acc * tiny, axis=axis, backend=backend,
+                   settings=settings)
+            return acc + jnp.real(y)[0, 0, 0] * scale
+        return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
+
+    return jax.jit(run)
+
+
 def timed_best(fn, x, inner: int) -> float:
     """Best-of-``inner`` wall-clock of one scalar-fenced call."""
     best = float("inf")

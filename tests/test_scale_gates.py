@@ -111,6 +111,55 @@ def test_batched2d_at_baseline_shape(devices):
     assert err < 1e-3, f"4096^2x64 batched-2d roundtrip max err {err}"
 
 
+def _cross_engine_max_rel_diff(n: int, be_a: str, be_b: str) -> float:
+    """Max relative difference between two INDEPENDENT distributed
+    pipelines' forward spectra of the same on-device random cube: slab
+    (backend ``be_a``) vs pencil (backend ``be_b``), different meshes,
+    different transpose schedules, different local-FFT implementations.
+    The diff/amax reduction runs on device with scalar readback only —
+    no dense host cube at any point, so this agreement check works at
+    sizes where the host-truth testcase 1 cannot (VERDICT r2 item 8)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedfft_tpu.testing import testcases  # noqa: F401 (mesh dep)
+
+    g = GlobalSize(n, n, n)
+    slab = SlabFFTPlan(g, SlabPartition(8), Config(fft_backend=be_a))
+    pencil = PencilFFTPlan(g, PencilPartition(2, 4), Config(fft_backend=be_b))
+    gen = jax.jit(lambda: jax.random.uniform(jax.random.key(7), g.shape,
+                                             jnp.float32),
+                  out_shardings=slab.input_sharding)
+    xs = gen()
+    a = slab.exec_r2c(xs)
+    b = pencil.exec_r2c(jax.device_put(xs, pencil.input_sharding))
+    nx, ny, nzo = slab.output_shape
+
+    def diff(a, b):
+        bb = b[:nx, :ny, :nzo]  # crop pencil padding; XLA inserts reshard
+        return jnp.max(jnp.abs(a - bb)), jnp.max(jnp.abs(a))
+
+    d, amax = jax.jit(diff)(a, b)
+    return float(d) / float(amax)
+
+
+@pytest.mark.parametrize("be_a,be_b", [("xla", "matmul")])
+def test_cross_engine_agreement_128(devices, be_a, be_b):
+    """Fast tier of the cross-engine gate (slab/xla vs pencil/matmul)."""
+    rel = _cross_engine_max_rel_diff(128, be_a, be_b)
+    assert rel <= 1e-3, f"128^3 cross-engine rel diff {rel}"
+
+
+@pytest.mark.skipif(not SLOW, reason="DFFT_SLOW_GATES=1 to run 1024^3")
+def test_cross_engine_agreement_1024(devices):
+    """North-star-scale truth without host truth: two independent engines
+    (slab+xla vs pencil+matmul) agree on the forward spectrum of the same
+    1024^3 f32 cube to 1e-3 relative, on device (VERDICT r2 item 8 'done'
+    criterion — the scale-proof analog of testcase 1)."""
+    rel = _cross_engine_max_rel_diff(1024, "xla", "matmul")
+    assert rel <= 1e-3, f"1024^3 cross-engine rel diff {rel}"
+
+
 @pytest.mark.skipif(not SLOW, reason="DFFT_SLOW_GATES=1 to run 1024^3")
 @pytest.mark.parametrize("kind", ["slab", "pencil"])
 def test_testcase4_runs_at_1024(devices, kind):
@@ -124,3 +173,27 @@ def test_testcase4_runs_at_1024(devices, kind):
     part = SlabPartition(8) if kind == "slab" else PencilPartition(2, 4)
     r = tc.testcase4(tc.make_plan(kind, g, part, Config()), write_csv=False)
     assert r["max_error"] < 3.0 * np.sqrt(g.n_total) * 1e-1
+
+
+@pytest.mark.parametrize("backend", ["xla", "matmul"])
+def test_fft3d_chunk_matches_fused(devices, backend):
+    """Config.fft3d_chunk (the memory-bounded single-device large-cube
+    path: z+y stages chunked via lax.map, x stage full-axis) must compute
+    the identical transform as the fused path."""
+    rng = np.random.default_rng(0)
+    g = GlobalSize(16, 12, 10)
+    x = rng.random(g.shape)
+    base = SlabFFTPlan(g, SlabPartition(1),
+                       Config(double_prec=True, fft_backend=backend))
+    chunked = SlabFFTPlan(g, SlabPartition(1),
+                          Config(double_prec=True, fft_backend=backend,
+                                 fft3d_chunk=4))
+    a = np.asarray(base.exec_r2c(x))
+    b = np.asarray(chunked.exec_r2c(x))
+    np.testing.assert_allclose(a, b, rtol=1e-12, atol=1e-12)
+    ya = np.asarray(base.exec_c2r(a))
+    yb = np.asarray(chunked.exec_c2r(b))
+    np.testing.assert_allclose(ya, yb, rtol=1e-12, atol=1e-12)
+    with pytest.raises(ValueError, match="divide"):
+        SlabFFTPlan(g, SlabPartition(1),
+                    Config(fft3d_chunk=5)).exec_r2c(x.astype(np.float32))

@@ -186,9 +186,12 @@ def _child_tpu(deadline_s: int) -> int:
             # here, and a process that exhausts them hands the remaining
             # sizes back to the parent for a process-level retry.
             last_err = None
-            for attempt in range(2):
+            size_mode = mode
+            attempts_left = 2
+            while attempts_left > 0:
+                attempts_left -= 1
                 try:
-                    if mode == "roundtrip" and n < 512:
+                    if size_mode == "roundtrip" and n < 512:
                         # Continuity with the committed artifact's
                         # methodology: host-staged input, roundtrip chain.
                         x = jax.device_put(np.random.default_rng(0)
@@ -204,9 +207,9 @@ def _child_tpu(deadline_s: int) -> int:
                         # per call and cancels in the pair difference.
                         x = 0  # rng seed
                         fn1 = chaintimer.directional_chain(1, shape,
-                                                           backend, mode)
+                                                           backend, size_mode)
                         fnK = chaintimer.directional_chain(k, shape,
-                                                           backend, mode)
+                                                           backend, size_mode)
                     float(fn1(x))  # compile + warm (scalar readback fences)
                     float(fnK(x))
                     per_ms, t1 = chaintimer.median_pair_diff_ms(
@@ -217,6 +220,20 @@ def _child_tpu(deadline_s: int) -> int:
                     raise
                 except Exception as e:  # noqa: BLE001 — roll a new compile
                     last_err = e
+                    if "RESOURCE_EXHAUSTED" in str(e):
+                        # Deterministic OOM: recompiling the identical
+                        # program cannot help, and purging the cache would
+                        # wipe the HEALTHY executables of other sizes (the
+                        # cache's whole purpose). For the north-star cube
+                        # fall back to forward-only with a FRESH attempt
+                        # budget (the fallback must not inherit a spent
+                        # one); other sizes stop retrying immediately.
+                        if size_mode == "roundtrip" and n >= 1024:
+                            # Roundtrip does not fit HBM (MEMORY_1024.md).
+                            size_mode = "forward"
+                            attempts_left = max(attempts_left, 2)
+                            continue
+                        break
                     try:
                         # The persistent cache serializes executables at
                         # COMPILE time, so a compiled-but-broken one would
@@ -244,12 +261,14 @@ def _child_tpu(deadline_s: int) -> int:
                     break
                 continue
             rec = {"per_iter_ms": round(per_ms, 4), "k": k}
-            if mode != "roundtrip":
-                rec["mode"] = mode
+            if size_mode != "roundtrip":
+                rec["mode"] = size_mode
+                if size_mode != mode:
+                    rec["mode_fallback"] = "roundtrip did not fit HBM"
             if per_ms <= 0:
                 rec["degenerate"] = True
             else:
-                flops = _flops_roundtrip(n) / (1 if mode == "roundtrip"
+                flops = _flops_roundtrip(n) / (1 if size_mode == "roundtrip"
                                                else 2)
                 rec["gflops"] = round(flops / per_ms / 1e6, 1)
             out["sizes"][str(n)] = rec
@@ -601,6 +620,8 @@ def main() -> int:
     fallback = pick is None
     result_extra = None
     mode = (tpu or {}).get("mode", "roundtrip")
+    if pick and measured[pick].get("mode"):
+        mode = measured[pick]["mode"]  # per-size HBM fallback changed it
     if not fallback:
         vs = (f"(vs argon single-GPU f64 cufftPlan3d {BASELINE_ROUNDTRIP_MS} "
               "ms; vs_baseline = baseline/ours, >1 is faster)"

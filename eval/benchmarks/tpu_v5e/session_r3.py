@@ -86,6 +86,12 @@ def main() -> int:
 
     emit({"event": "start", "platform": jax.devices()[0].platform,
           "budget_s": BUDGET_S, "smoke": smoke})
+    # The device claim above may have WAITED OUT a tunnel wedge (by
+    # design — a waiting claim clears, a killed one re-wedges). The
+    # measurement budget starts from first device contact, not process
+    # launch, or a long wait would starve every measurement.
+    global T0
+    T0 = time.monotonic()
 
     from distributedfft_tpu.ops import mxu_fft as mx
     from distributedfft_tpu.testing import chaintimer as ct

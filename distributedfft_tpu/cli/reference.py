@@ -9,6 +9,11 @@ Testcases (the reference's 1D/2D/3D-memcpy bandwidth probes, strategy via
   1: 1D geometry — slab transpose over a 1D mesh.
   2: 2D geometry — pencil transpose over one axis of a 2D mesh.
   3: 3D geometry — both non-exchanged axes sharded (strided in two axes).
+  4: north-star fraction gate — the slab pipeline transpose's achieved
+     fraction of the raw collective ceiling, via the interleaved
+     K-chained-pair methodology (``microbench.transpose_fraction_chain``:
+     the ceiling's work is a per-iteration subset of the pipeline's, so
+     the fraction is <=1 in expectation, reported with a spread).
 Each bandwidth line reports the collectives found in the compiled HLO, so
 a GSPMD 'reshard' that XLA elided would be visible as an empty list.
 """
@@ -101,6 +106,39 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
               f"[{kind}, {geometry}, {p} devices, "
               f"{r['bytes'] / 1e6:.1f} MB moved in {r['seconds'] * 1e3:.3f} ms, "
               f"collectives={r['collective_ops']}]")
+        return 0
+    if args.testcase == 4:
+        import numpy as np
+
+        from .. import params as pm
+        from ..models.slab import SlabFFTPlan
+
+        g = pm.GlobalSize(*shape)
+        plan = SlabFFTPlan(g, pm.SlabPartition(p),
+                           pm.Config(comm_method=pm.CommMethod.ALL2ALL,
+                                     double_prec=args.double_prec))
+        x = plan.pad_input(np.random.default_rng(0).random(g.shape)
+                           .astype(dtype))
+        spec = plan.forward_stages()[0][1](x)
+        try:
+            r = mb.transpose_fraction_chain(plan, spec,
+                                            repeats=max(it or 1, 3),
+                                            warmup=max(wu, 1))
+        except ValueError as e:  # shape/divisibility precondition
+            print(f"fraction gate unavailable for this shape: {e}",
+                  file=sys.stderr)
+            return 2
+        if r.get("degenerate"):
+            print(f"fraction chain degenerate ({r['dropped']} repeats "
+                  "noise-swamped; raise -i or use a bigger size)",
+                  file=sys.stderr)
+            return 1
+        lo, hi = r["fraction_spread"]
+        print(f"All2All fraction: {r['fraction']:.3f} "
+              f"[spread {lo:.3f}-{hi:.3f}, pipeline "
+              f"{r['pipe_gb_per_s']:.3f} GB/s vs ceiling "
+              f"{r['raw_gb_per_s']:.3f} GB/s, k={r['k']}, "
+              f"{p} devices]")
         return 0
     print(f"unknown testcase {args.testcase}", file=sys.stderr)
     return 2

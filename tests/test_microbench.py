@@ -62,21 +62,25 @@ def test_wire_bandwidth_rejects_indivisible(devices):
         mb.wire_bandwidth((16, 16, 16), 8)
 
 
+def _slab_prexpose_spec(n: int, p: int = 8):
+    """(plan, pre-transpose spectral volume) — the fraction chain's
+    operands, shared by the gate tests (and mirrored in the -t 4 CLI)."""
+    import distributedfft_tpu as dfft
+
+    g = dfft.GlobalSize(n, n, n)
+    plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(p),
+                            dfft.Config(comm_method=dfft.CommMethod.ALL2ALL))
+    x = plan.pad_input(np.random.default_rng(0).random(g.shape)
+                       .astype(np.float32))
+    return plan, plan.forward_stages()[0][1](x)
+
+
 def test_transpose_fraction_chain_is_a_gate(devices):
     """The chained interleaved-pair fraction (north-star gate): ceiling
     work is a per-iteration subset of pipeline work, so the median
     fraction lands in (0, 1] up to measurement noise, with a reported
     spread (VERDICT r2: a fraction >1 is not a gate)."""
-    import numpy as np
-
-    import distributedfft_tpu as dfft
-
-    g = dfft.GlobalSize(64, 64, 64)
-    plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(8),
-                            dfft.Config(comm_method=dfft.CommMethod.ALL2ALL))
-    x = plan.pad_input(np.random.default_rng(0).random(g.shape)
-                       .astype(np.float32))
-    spec = plan.forward_stages()[0][1](x)
+    plan, spec = _slab_prexpose_spec(64)
     r = mb.transpose_fraction_chain(plan, spec, k=6, repeats=3)
     if r.get("degenerate"):
         pytest.skip("all repeats noise-swamped on this host")
@@ -90,14 +94,19 @@ def test_transpose_fraction_chain_is_a_gate(devices):
 
 
 def test_transpose_fraction_chain_rejects_bad_divisibility(devices):
-    import numpy as np
-
-    import distributedfft_tpu as dfft
-
-    g = dfft.GlobalSize(32, 32, 32)  # local leading 4, not divisible by 8
-    plan = dfft.SlabFFTPlan(g, dfft.SlabPartition(8), dfft.Config())
-    x = plan.pad_input(np.random.default_rng(0).random(g.shape)
-                       .astype(np.float32))
-    spec = plan.forward_stages()[0][1](x)
+    # 32^3 over 8: local leading extent 4, not divisible by 8
+    plan, spec = _slab_prexpose_spec(32)
     with pytest.raises(ValueError, match="divisible"):
         mb.transpose_fraction_chain(plan, spec, k=2, repeats=1)
+
+
+def test_reference_cli_fraction_gate(devices, capsys):
+    """dfft-reference -t 4: the north-star fraction gate as a CLI probe."""
+    from distributedfft_tpu.cli import reference
+
+    rc = reference.main(["-nx", "64", "-ny", "64", "-nz", "64", "-t", "4",
+                         "-i", "3", "--emulate-devices", "8"])
+    out = capsys.readouterr().out
+    assert rc in (0, 1)  # 1 = degenerate on a hopelessly loaded host
+    if rc == 0:
+        assert "All2All fraction:" in out and "ceiling" in out

@@ -29,14 +29,15 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .common import add_common_args, run_testcase, setup_backend
+from .common import (add_common_args, maybe_autotune_comm, run_testcase,
+                     setup_backend)
 
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="batched", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    add_common_args(ap, pencil=False, comm_tunable=False)
+    add_common_args(ap, pencil=False, comm_tunable=True)
     ap.add_argument("--shard", default="batch", choices=("batch", "x"),
                     help="decomposed axis: 'batch' (no collectives) or 'x' "
                          "(slab-style transpose pipeline)")
@@ -71,6 +72,15 @@ def main(argv=None) -> int:
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
         fft_backend=args.fft_backend)
+    if getattr(args, "autotune_comm", False):
+        if args.shard != "x":
+            print("autotune-comm: shard='batch' issues no collectives; "
+                  "nothing to tune")
+        else:
+            g = pm.GlobalSize(args.input_dim_z, args.input_dim_x,
+                              args.input_dim_y)  # (batch, nx, ny) slots
+            cfg = maybe_autotune_comm(args, "batched2d", g,
+                                      pm.SlabPartition(p), cfg, dims=2)
     plan = Batched2DFFTPlan(
         batch=args.input_dim_z, nx=args.input_dim_x, ny=args.input_dim_y,
         partition=pm.SlabPartition(p), config=cfg, shard=args.shard,

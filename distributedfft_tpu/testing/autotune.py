@@ -199,7 +199,8 @@ def _time_plan_ms(fn, x, iterations: int, warmup: int) -> float:
 def autotune_comm(kind: str, global_size, partition, base_config=None,
                   mesh=None, sequence=None, iterations: int = 5,
                   warmup: int = 2, race_opt: bool = True, seed: int = 0,
-                  dims: int = 3, verbose: bool = False) -> List[CommCandidate]:
+                  dims: int = 3, transform: str = "r2c",
+                  verbose: bool = False) -> List[CommCandidate]:
     """Race the communication strategies for a plan shape ON the active
     mesh: ALL2ALL (explicit ``lax.all_to_all``) vs PEER2PEER (GSPMD
     resharding) per transpose, crossed with the opt 0/1 layout axis — at
@@ -242,7 +243,8 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
             cfg = dc.replace(base, comm_method=c.comm, comm_method2=c.comm2,
                              opt=c.opt)
             plan = tc.make_plan(kind, global_size, partition, cfg,
-                                sequence=sequence, mesh=mesh)
+                                sequence=sequence, mesh=mesh,
+                                transform=transform)
             x = plan.pad_input(xs)
             fwd, inv = tc._fused_fns(plan, dims)
             c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)

@@ -44,12 +44,23 @@ from . import sharded
 
 
 def make_plan(kind: str, global_size: pm.GlobalSize, partition, config,
-              sequence=None, mesh=None):
+              sequence=None, mesh=None, transform: str = "r2c"):
+    """``transform`` must match the program the caller will actually run
+    (the comm autotuner races THIS plan — a c2c run tuned on an r2c plan
+    would time transposes moving roughly half the bytes)."""
     if kind == "slab":
         return SlabFFTPlan(global_size, partition, config, mesh=mesh,
-                           sequence=sequence or pm.SlabSequence.ZY_THEN_X)
+                           sequence=sequence or pm.SlabSequence.ZY_THEN_X,
+                           transform=transform)
+    if kind == "batched2d":
+        # Size-slot convention of the batched plan's global_size property:
+        # (batch, nx, ny). Comm only matters for the x decomposition.
+        g = global_size
+        return Batched2DFFTPlan(g.nx, g.ny, g.nz, partition, config,
+                                mesh=mesh, shard="x", transform=transform)
     if kind == "pencil":
-        return PencilFFTPlan(global_size, partition, config, mesh=mesh)
+        return PencilFFTPlan(global_size, partition, config, mesh=mesh,
+                             transform=transform)
     raise ValueError(f"unknown plan kind {kind!r}")
 
 

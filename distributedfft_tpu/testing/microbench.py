@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..parallel.mesh import make_pencil_mesh, make_slab_mesh
-from ..parallel.transpose import all_to_all_transpose
+from ..parallel.transpose import all_to_all_transpose, realigned_pack_shape
 
 
 def _time_fn(fn, x, iterations: int, warmup: int) -> float:
@@ -89,26 +89,43 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
     BY CONSTRUCTION in expectation (VERDICT r2: a gate whose measured
     value exceeds 1 is not a gate).
 
-    Method: two K-chained jitted programs over the SAME mesh, shard
-    shapes, and dtype —
+    Method: K-chained jitted programs over the SAME mesh, shard shapes,
+    and dtype —
 
-    * pipeline chain: K iterations of (forward transpose ∘ inverse
-      transpose), the slab pipeline's own bodies (shard-local relayout +
-      ``lax.all_to_all``), layout-stable per iteration;
-    * ceiling chain: K iterations of two PURE exchanges
+    * pipeline chains: K iterations of (forward transpose ∘ inverse
+      transpose), the slab pipeline's own bodies
+      (``plan._xpose_bodies``), one chain per layout rendering
+      (``opt0`` = XLA's native ``split != concat`` lowering, ``opt1`` =
+      realigned pack + pure exchange), layout-stable per iteration;
+    * ceiling chains: K iterations of two PURE exchanges
       (``split_axis == concat_axis``, zero relayout) — the same wire
-      bytes per iteration, a strict subset of the pipeline iteration's
-      work.
+      bytes per iteration, a strict subset of every pipeline iteration's
+      work. TWO pure layouts are timed (the pipeline input's own shape,
+      and the opt1 pack's merged-leading shape — the exchange the
+      realigned pipe actually issues) and each repeat's ceiling is the
+      FASTER of the two: a pure exchange of the same bytes in a better
+      layout is still "pure exchange", and a ceiling the pipe can beat
+      is not a ceiling (observed: the merged layout's bigger contiguous
+      chunks exchange measurably faster at 128^3 on the CPU mesh).
 
     Each is timed as a ((t_K - t_1)/(K-1)) pair difference — the chain
     amortizes the host's run-to-run dispatch noise that made single-window
-    ratios land anywhere in 0.5-1.4 — and the two sides' pairs run within
-    the same repeat (pipe_K, pipe_1, raw_K, raw_1 per repeat) so slow
-    drift hits both sides of each fraction sample. Reports the per-repeat
-    fractions, their median, and spread.
+    ratios land anywhere in 0.5-1.4 — and all chains' pairs run within
+    the same repeat so slow drift hits both sides of each fraction sample.
 
-    A repeat whose pair difference comes out nonpositive (work swamped by
-    noise — the chaintimer degenerate contract) is DROPPED; if every
+    The gate value is produced in two phases so racing variants adds no
+    selection bias (max-of-noisy-medians systematically reads high): a
+    SELECTION phase races every variant against the ceiling and picks the
+    winner by median fraction; a fresh PUBLICATION phase then re-measures
+    ONLY the winner against the ceiling and publishes those repeats'
+    median and spread. Result carries ``variant`` (the winner's name),
+    ``variants`` (selection-phase fractions, for visibility — not gate
+    values), and the published ``fraction``/``fraction_spread``.
+
+    A pair difference that comes out nonpositive (work swamped by noise —
+    the chaintimer degenerate contract) drops that variant's sample for
+    the repeat; a repeat with NO positive ceiling sample (both pure
+    layouts degenerate) is dropped for every variant. If every publication
     repeat degenerates the result carries ``degenerate: True`` and no
     fraction, which callers must not publish as a gate value.
     """
@@ -117,8 +134,6 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
     from ..parallel.mesh import SLAB_AXIS
 
     mesh = plan.mesh
-    xf = plan._fwd_parts()[1]
-    xi = plan._inv_parts()[1]
     ispec = plan._in_spec
 
     def chained(body_pair, kk):
@@ -127,6 +142,10 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
         sm = jax.shard_map(body, mesh=mesh, in_specs=ispec, out_specs=ispec)
         return jax.jit(sm, in_shardings=NamedSharding(mesh, ispec),
                        out_shardings=NamedSharding(mesh, ispec))
+
+    def pipe_pair(realigned):
+        xf, xi = plan._xpose_bodies(realigned)
+        return lambda w: xi(xf(w))
 
     def pure_pair(w):
         w = lax.all_to_all(w, SLAB_AXIS, split_axis=0, concat_axis=0,
@@ -140,42 +159,98 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
         raise ValueError(
             f"fraction chain needs the local leading extent {local0} "
             f"divisible by {p} (tiled pure exchange re-splits it)")
-    fns = {"pipe": (chained(lambda w: xi(xf(w)), 1),
-                    chained(lambda w: xi(xf(w)), k)),
-           "raw": (chained(pure_pair, 1), chained(pure_pair, k))}
-    for f1, fK in fns.values():  # compile + warm both chains up front
-        jax.block_until_ready(f1(spec_val))
-        jax.block_until_ready(fK(spec_val))
+    # Second pure layout: the merged-leading shape the opt1 pack exchanges
+    # (realigned_pack_shape — same bytes, bigger contiguous per-peer
+    # chunks), derived from the same helper the transpose uses so the
+    # ceiling cannot drift from the exchange the realigned pipe issues.
+    merged_shape = realigned_pack_shape(spec_val.shape,
+                                        plan._seq.split_axis, p)
+    merged_val = jax.device_put(
+        jnp.zeros(merged_shape, spec_val.dtype),
+        NamedSharding(mesh, ispec))
+    fns = {"opt0": (chained(pipe_pair(False), 1), chained(pipe_pair(False), k)),
+           "opt1": (chained(pipe_pair(True), 1), chained(pipe_pair(True), k)),
+           "raw": (chained(pure_pair, 1), chained(pure_pair, k)),
+           "raw_merged": (chained(pure_pair, 1), chained(pure_pair, k))}
+    args = {n: merged_val if n == "raw_merged" else spec_val for n in fns}
+    for name, (f1, fK) in fns.items():  # compile + warm all chains up front
+        jax.block_until_ready(f1(args[name]))
+        jax.block_until_ready(fK(args[name]))
 
-    fractions, pipe_s, raw_s, dropped = [], [], [], 0
-    for _ in range(repeats):
-        per = {}
-        for name, (f1, fK) in fns.items():
-            tK = _time_fn(fK, spec_val, iterations, warmup)
-            t1 = _time_fn(f1, spec_val, iterations, warmup)
-            per[name] = (tK - t1) / (k - 1)
-        if per["pipe"] <= 0 or per["raw"] <= 0:
-            dropped += 1  # noise swamped the chain: not a timing
-            continue
-        pipe_s.append(per["pipe"])
-        raw_s.append(per["raw"])
-        fractions.append(per["raw"] / per["pipe"])
-    if not fractions:
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    raw_names = ("raw", "raw_merged")
+
+    def run_repeats(names, n_repeats):
+        """Paired repeats over the named chains; per-variant dropping (no
+        positive ceiling sample drops the repeat for every variant). The
+        repeat's ceiling — recorded under ``"ceil"`` — is the FASTER of
+        the two pure layouts."""
+        fracs = {n: [] for n in names if n not in raw_names}
+        times = {n: [] for n in fracs}
+        times["ceil"] = []
+        for _ in range(n_repeats):
+            per = {}
+            for name in names:
+                f1, fK = fns[name]
+                tK = _time_fn(fK, args[name], iterations, warmup)
+                t1 = _time_fn(f1, args[name], iterations, warmup)
+                per[name] = (tK - t1) / (k - 1)
+            ceil_s = [per[n] for n in raw_names if n in per and per[n] > 0]
+            if not ceil_s:
+                continue  # no ceiling: nothing comparable this repeat
+            ceil = min(ceil_s)
+            contributed = False
+            for n in fracs:
+                if per[n] > 0:
+                    times[n].append(per[n])
+                    fracs[n].append(ceil / per[n])
+                    contributed = True
+            if contributed:
+                # Keep the ceiling median paired with the variant medians:
+                # a repeat that produced no variant sample must not skew
+                # the published raw side either.
+                times["ceil"].append(ceil)
+        return fracs, times
+
+    # SELECTION phase: race every variant; pick the winner by median
+    # fraction. These samples are NOT published (max-of-noisy-medians is
+    # biased high — the publication phase re-measures fresh).
+    sel_fracs, _ = run_repeats(list(fns), repeats)
+    by_variant = {}
+    for n, fs in sel_fracs.items():
+        if fs:
+            fs = sorted(fs)
+            by_variant[n] = {
+                "fraction": round(med(fs), 4),
+                "fraction_spread": [round(fs[0], 4), round(fs[-1], 4)],
+            }
+    if not by_variant:
         return {"degenerate": True, "k": k, "repeats": repeats,
-                "dropped": dropped}
-    fractions.sort()
-    med = fractions[len(fractions) // 2]
+                "dropped": repeats, "phase": "selection"}
+    winner = max(by_variant, key=lambda n: by_variant[n]["fraction"])
+
+    # PUBLICATION phase: fresh paired repeats of the winner vs the ceiling.
+    pub_fracs, pub_times = run_repeats([winner, "raw", "raw_merged"],
+                                       repeats)
+    fs = sorted(pub_fracs[winner])
+    if not fs:
+        return {"degenerate": True, "k": k, "repeats": repeats,
+                "dropped": repeats, "phase": "publication",
+                "variant": winner, "variants": by_variant}
     # 2 exchanges of the pre-transpose volume per chain iteration.
     nbytes = 2 * spec_val.nbytes
-    pipe_med = sorted(pipe_s)[len(pipe_s) // 2]
-    raw_med = sorted(raw_s)[len(raw_s) // 2]
     out = {
-        "fraction": round(med, 4),
-        "fraction_spread": [round(fractions[0], 4), round(fractions[-1], 4)],
-        "pipe_gb_per_s": round(nbytes / pipe_med / 1e9, 3),
-        "raw_gb_per_s": round(nbytes / raw_med / 1e9, 3),
+        "fraction": round(med(fs), 4),
+        "fraction_spread": [round(fs[0], 4), round(fs[-1], 4)],
+        "variant": winner,
+        "variants": by_variant,
+        "pipe_gb_per_s": round(nbytes / med(pub_times[winner]) / 1e9, 3),
+        "raw_gb_per_s": round(nbytes / med(pub_times["ceil"]) / 1e9, 3),
         "k": k, "repeats": repeats,
     }
+    dropped = repeats - len(fs)
     if dropped:
         out["dropped"] = dropped
     return out

@@ -1,0 +1,291 @@
+"""MXU-utilization roofline for the matmul FFT backend (VERDICT r3 item 5).
+
+The artifact CSVs quote FFT-NOMINAL GFLOPS (2.5·N·log2 N — the rate a
+textbook FFT would need, BASELINE.md §Derived), which is the right number
+for cross-framework comparison but the wrong denominator for "is the chip
+busy": the matmul backend executes O(n) MXU MACs per element per axis, not
+O(log n). This module counts the MACs the backend ACTUALLY issues — by
+mirroring the dispatch logic of ``ops/mxu_fft.py`` (direct vs four-step vs
+radix-2, R2C/C2R real-matmul fast paths, XLA's 4-real-matmul complex dot
+decomposition) — and converts each measured row into achieved MXU TFLOPS
+and fraction of the v5e's effective peak.
+
+Peak model: one v5e chip peaks at 197 bf16 TFLOPS (public spec). The
+backend's default precision is ``HIGH`` = 3-pass bf16 emulation of f32
+(``MXUSettings.precision`` docstring), so its effective peak is 197/3;
+``HIGHEST`` is 6-pass (197/6).
+
+Reference anchor: the reference derives GPU efficiency from cuFFT's nominal
+flops only (``/root/reference/eval/complete/scalability.py``); a
+hardware-true denominator is an extension.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from typing import Dict, Optional
+
+from ..ops.mxu_fft import DIRECT_MAX, _R2_BASE, _split
+
+V5E_PEAK_BF16_TFLOPS = 197.0
+
+# MXU passes per f32-emulating matmul at each lax.Precision.
+_PREC_PASSES = {"default": 1, "high": 3, "highest": 6}
+
+
+def effective_peak_tflops(precision: str = "high") -> float:
+    """v5e effective matmul peak for f32 data at the given precision."""
+    return V5E_PEAK_BF16_TFLOPS / _PREC_PASSES[precision]
+
+
+# ---------------------------------------------------------------------------
+# Per-element MAC counts, mirroring ops/mxu_fft.py dispatch
+# ---------------------------------------------------------------------------
+
+
+def macs_c2c_axis(n: int, direct_max: int = DIRECT_MAX,
+                  radix2: bool = False, complex_mults: int = 4) -> float:
+    """MXU MACs per element for one C2C pass along an axis of length ``n``
+    (``_fft_last``): direct = one complex matmul lowered to
+    ``complex_mults`` real depth-n matmuls; four-step recurses on both
+    factors; radix-2 DIF halves the depth per level down to ``_R2_BASE``
+    = 128 (butterflies/twiddles are VPU work, not MXU).
+
+    ``complex_mults``: the textbook complex-dot lowering is 4 real
+    matmuls (ArFr - AiFi, ArFi + AiFr); a 3-multiplication Karatsuba-form
+    lowering also exists. Measured rows that exceed 100% of peak under
+    the 4-matmul model (128^3) prove the compiler's actual lowering is
+    cheaper than 4 — so the two models BRACKET the hardware count, and
+    the roofline reports both."""
+    if radix2 and n > _R2_BASE and n % 2 == 0:
+        return macs_c2c_axis(n // 2, direct_max, radix2, complex_mults)
+    if n <= direct_max:
+        return float(complex_mults) * n
+    n1, n2 = _split(n)
+    if n1 == 1:
+        return float(complex_mults) * n
+    return (macs_c2c_axis(n2, direct_max, radix2, complex_mults)
+            + macs_c2c_axis(n1, direct_max, radix2, complex_mults))
+
+
+def macs_r2c_axis(n: int, direct_max: int = DIRECT_MAX,
+                  complex_mults: int = 4) -> float:
+    """MACs per INPUT element for the R2C first pass (``_rfft_last``):
+    direct = 2 real n->n_out matmuls (2·n_out MACs/element); four-step =
+    real depth-n2 pair + complex depth-n1 on the FULL volume (the crop to
+    n_out happens after the transform)."""
+    n_out = n // 2 + 1
+    if n <= direct_max:
+        return 2.0 * n_out
+    n1, n2 = _split(n)
+    if n1 == 1:
+        return 2.0 * n_out
+    return 2.0 * n2 + macs_c2c_axis(n1, direct_max,
+                                    complex_mults=complex_mults)
+
+
+def macs_c2r_axis(n: int, direct_max: int = DIRECT_MAX,
+                  complex_mults: int = 4, radix2: bool = False) -> float:
+    """MACs per OUTPUT element for the C2R last pass (``irfft``): direct =
+    2 real depth-n_out matmuls with conjugate symmetry folded in
+    (``_c2r_np``); beyond direct_max the code Hermitian-extends and runs a
+    full complex inverse (``_fft_last`` cost on the full length — which
+    honors the radix-2 setting, so the model must too)."""
+    n_out = n // 2 + 1
+    if n <= direct_max:
+        return 2.0 * n_out
+    return macs_c2c_axis(n, direct_max, radix2, complex_mults)
+
+
+# ---------------------------------------------------------------------------
+# Whole-workload MXU flops (2 flops per MAC)
+# ---------------------------------------------------------------------------
+
+
+def mxu_flops_roundtrip_3d(n: int, direct_max: int = DIRECT_MAX,
+                           radix2: bool = False,
+                           complex_mults: int = 4) -> float:
+    """MXU flops the matmul backend executes for one R2C+C2R roundtrip of
+    an ``n^3`` f32 cube (``rfftn_3d`` then ``irfftn_3d``): z R2C pass on
+    the full cube, two C2C passes each way on the halved volume, z C2R
+    pass back to the full cube. Radix-2 applies to the C2C stages only
+    (``_rfft_last`` never takes the radix-2 branch)."""
+    n_out = n // 2 + 1
+    v_half = n * n * n_out
+    macs = (n ** 3 * macs_r2c_axis(n, direct_max, complex_mults)
+            + 4 * v_half * macs_c2c_axis(n, direct_max, radix2,
+                                         complex_mults)
+            + n ** 3 * macs_c2r_axis(n, direct_max, complex_mults, radix2))
+    return 2.0 * macs
+
+
+def mxu_flops_batched2d(batch: int, m: int, direct_max: int = DIRECT_MAX,
+                        complex_mults: int = 4,
+                        radix2: bool = False) -> float:
+    """MXU flops for one batched-2D R2C+C2R roundtrip of ``batch`` m x m
+    planes (``Batched2DFFTPlan``): per plane, an R2C pass over m rows, one
+    C2C pass each way on the halved volume, and a C2R pass back."""
+    m_out = m // 2 + 1
+    v_half = m * m_out
+    macs_plane = (m * m * macs_r2c_axis(m, direct_max, complex_mults)
+                  + 2 * v_half * macs_c2c_axis(m, direct_max, radix2,
+                                               complex_mults)
+                  + m * m * macs_c2r_axis(m, direct_max, complex_mults,
+                                          radix2))
+    return 2.0 * batch * macs_plane
+
+
+# ---------------------------------------------------------------------------
+# Roofline table from the committed measurement CSV
+# ---------------------------------------------------------------------------
+
+_CSV = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..",
+                    "eval", "benchmarks", "tpu_v5e",
+                    "single_chip_chain_timed.csv")
+
+# backend label -> (counts_on_mxu, precision, radix2). The all-real-planes
+# formulation issues the identical matmuls on split (re, im) planes, so it
+# shares the matmul count; XLA's native FFT is not a matmul pipeline and
+# pallas kernels schedule their own MXU passes — no honest count for
+# either, so they are skipped rather than guessed.
+_BACKENDS = {
+    "matmul@high": ("high", False),
+    "matmul@highest": ("highest", False),
+    "matmul-r2@high": ("high", True),
+    "matmul-planes": ("high", False),
+}
+
+
+def roofline_rows(csv_path: str = _CSV) -> list:
+    """Parse the measured CSV and return roofline dicts for every row
+    whose backend has an exact MXU MAC count."""
+    out = []
+    with open(csv_path) as f:
+        header = f.readline().strip().split(",")
+        idx = {k: i for i, k in enumerate(header)}
+        for line in f:
+            parts = line.rstrip("\n").split(",")
+            if len(parts) < 5:
+                continue
+            size, transform = parts[idx["size"]], parts[idx["transform"]]
+            backend = parts[idx["backend"]]
+            per_ms = float(parts[idx["per_iter_ms"]])
+            nominal = float(parts[idx["gflops"]])
+            if backend not in _BACKENDS or "roundtrip" not in transform:
+                continue
+            precision, r2 = _BACKENDS[backend]
+            m_cube = re.fullmatch(r"(\d+)\^3", size)
+            m_b2d = re.fullmatch(r"(\d+)\^2x(\d+)", size)
+            if m_cube:
+                n = int(m_cube.group(1))
+                f4 = mxu_flops_roundtrip_3d(n, radix2=r2)
+                f3 = mxu_flops_roundtrip_3d(n, radix2=r2, complex_mults=3)
+            elif m_b2d:
+                m, b = int(m_b2d.group(1)), int(m_b2d.group(2))
+                f4 = mxu_flops_batched2d(b, m, radix2=r2)
+                f3 = mxu_flops_batched2d(b, m, complex_mults=3, radix2=r2)
+            else:
+                continue
+            peak = effective_peak_tflops(precision)
+            t4 = f4 / (per_ms * 1e-3) / 1e12
+            t3 = f3 / (per_ms * 1e-3) / 1e12
+            out.append({
+                "size": size, "backend": backend,
+                "per_iter_ms": per_ms, "nominal_gflops": nominal,
+                "mxu_tflops_4mm": round(t4, 1),
+                "mxu_tflops_3mm": round(t3, 1),
+                "peak_tflops": round(peak, 1),
+                "util_4mm": round(t4 / peak, 3),
+                "util_3mm": round(t3 / peak, 3),
+            })
+    return out
+
+
+def _cube512_clause(rows) -> str:
+    """Utilization bounds for the headline 512^3 matmul@high row, quoted
+    FROM the rendered rows so the narrative can never contradict its own
+    table; empty when that row is absent."""
+    for r in rows:
+        if r["size"] == "512^3" and r["backend"] == "matmul@high":
+            return (f" (512^3 runs at {100 * r['util_3mm']:.0f}-"
+                    f"{100 * r['util_4mm']:.0f}% of effective peak)")
+    return ""
+
+
+def render_markdown(rows, path: Optional[str] = None) -> str:
+    lines = [
+        "# MXU-utilization roofline (v5e single chip)",
+        "",
+        "Measured roundtrip rows from `single_chip_chain_timed.csv`, with",
+        "the MXU flops the matmul backend ACTUALLY executes (counted by",
+        "`evalkit/roofline.py`, mirroring `ops/mxu_fft.py` dispatch)",
+        "against the v5e's effective peak (197 bf16 TFLOPS; `HIGH` = 3-pass",
+        "bf16 f32 emulation -> 65.7 TFLOPS effective, `HIGHEST` = 6-pass",
+        "-> 32.8).",
+        "",
+        "| size | backend | ms/iter | nominal GFLOPS | MXU TFLOPS "
+        "(3mm-4mm) | eff. peak | utilization (3mm-4mm) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['size']} | {r['backend']} | {r['per_iter_ms']:.4f} | "
+            f"{r['nominal_gflops']:.1f} | "
+            f"{r['mxu_tflops_3mm']:.1f}-{r['mxu_tflops_4mm']:.1f} | "
+            f"{r['peak_tflops']:.1f} | "
+            f"{100 * r['util_3mm']:.1f}-{100 * r['util_4mm']:.1f}% |")
+    lines += [
+        "",
+        "The two bounds bracket XLA's complex-dot lowering: `4mm` = the",
+        "textbook 4-real-matmul decomposition, `3mm` = the 3-multiplication",
+        "Karatsuba form. The 128^3 row EXCEEDING peak under 4mm proves the",
+        "actual lowering is cheaper than 4 matmuls, so the hardware truth",
+        "lies between the columns (R2C/C2R passes are exact in both — they",
+        "are explicit real-matmul pairs in `ops/mxu_fft.py`). For",
+        "`matmul-planes` the 4mm column is EXACT everywhere: `_rp_stage`",
+        "writes the 4 real einsums out explicitly, nothing is left to the",
+        "compiler's complex lowering.",
+        "",
+        "Reading: NOMINAL GFLOPS (2.5·N·log2 N — what a textbook FFT would",
+        "need) falls with size because the matmul backend spends O(n)",
+        "MACs/element per axis, while MXU utilization stays high — the",
+        "256^3 -> 512^3 nominal drop (1357.6 -> 814.9) is the O(n)/O(log n)",
+        "flop-count ratio growing, not the chip idling"
+        + _cube512_clause(rows) + ". The outliers are the point of the",
+        "table: matmul-r2's low utilization shows its interleave relayout",
+        "starving the MXU (matching its measured net loss), and the",
+        "2048^2x64 row's ~5% shows the four-step swapaxes relayouts are",
+        "HBM-bound — the chunk sweep (session_r3.py part 6) attacks",
+        "exactly that.",
+    ]
+    text = "\n".join(lines) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        "dfft-roofline", description="Render the MXU roofline table from "
+        "the committed single-chip measurement CSV.")
+    ap.add_argument("--csv", default=_CSV)
+    ap.add_argument("--out", default=None,
+                    help="write markdown here (default: print)")
+    a = ap.parse_args(argv)
+    if not os.path.exists(a.csv):
+        ap.error(f"measurement CSV not found: {a.csv} — the default path "
+                 "resolves inside a source checkout (eval/ is not "
+                 "packaged); pass --csv explicitly")
+    rows = roofline_rows(a.csv)
+    text = render_markdown(rows, a.out)
+    if not a.out:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,7 @@ def test_full_orchestration_off_tunnel():
     a real measurement (no fallback: the 'tpu' child succeeds on CPU)."""
     d = _run_bench({"DFFT_BENCH_FORCE_CPU": "1",
                     "DFFT_BENCH_SIZES": "32",
+                    "DFFT_BENCH_BATCHED": "2,16,1",
                     "DFFT_BENCH_MESH_N": "32"})
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in d, d
@@ -49,6 +50,10 @@ def test_full_orchestration_off_tunnel():
     # mesh geometry matrix ran (the raw wire probe legitimately cannot:
     # a 32^3 spectral volume fails its p^2 divisibility precondition)
     assert d.get("geometry_gb_per_s"), d
+    # batched-2D row measured under its non-numeric key, and it did NOT
+    # headline (the cube did)
+    brec = d["tpu_sizes"]["16^2x2"]
+    assert "per_iter_ms" in brec and brec.get("batch_chunk") == 1, d
 
 
 def test_bench_sizes_tolerates_malformed_env(monkeypatch):
@@ -74,6 +79,7 @@ def test_child_json_contract():
     """Each child prints its own one-line JSON even under the test hooks."""
     env = dict(os.environ)
     env.update({"DFFT_BENCH_FORCE_CPU": "1", "DFFT_BENCH_SIZES": "16",
+                "DFFT_BENCH_BATCHED": "not,a,spec",
                 "DFFT_BENCH_MESH_N": "16"})
     for child, extra in (("probe", []), ("tpu", ["60"])):
         r = subprocess.run([sys.executable, BENCH, "--child", child, *extra],
@@ -82,3 +88,8 @@ def test_child_json_contract():
         assert r.returncode == 0, (child, r.stderr[-300:])
         parsed = json.loads(r.stdout.strip().splitlines()[-1])
         assert isinstance(parsed, dict), child
+        if child == "tpu":
+            # Malformed DFFT_BENCH_BATCHED degrades to a diagnostic, and
+            # the cube sweep's record survives it.
+            assert "batched2d_error" in parsed, parsed
+            assert "16" in parsed.get("sizes", {}), parsed

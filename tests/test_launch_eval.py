@@ -136,6 +136,53 @@ class TestEvalKit:
         effs = [float(r[6]) for r in recs]
         assert effs[0] == 1.0 and abs(effs[1] - 1.0) < 1e-9
 
+    def test_scalability_stages_classification(self, tmp_path):
+        """FFT vs Transpose phase classes sum from the raw Timer marks;
+        ratios are relative to the series' smallest P."""
+        bench = str(tmp_path / "bench")
+        descs = ["init", "1D FFT Z-Direction",
+                 "Transpose (Finished All2All)", "1D FFT X-Direction",
+                 "Run complete"]
+        for p, scale in ((4, 1.0), (8, 2.0)):
+            vdir = os.path.join(bench, "slab_default")
+            fname = f"test_0_1_0_16_16_16_0_{p}.csv"
+            t = Timer(descs, p, os.path.join(vdir, fname))
+            for _ in range(3):
+                t.start()
+                # cumulative timeline marks (the Timer stores the mark at
+                # which each phase FINISHED): 2 ms FFT-Z, 3 ms transpose,
+                # 6 ms FFT-X.
+                t._durations = {
+                    "1D FFT Z-Direction": 2.0 * scale,
+                    "Transpose (Finished All2All)": 5.0 * scale,
+                    "1D FFT X-Direction": 11.0 * scale,
+                    "Run complete": 11.0 * scale}
+                t.gather()
+        rows = evaluate.scalability_stages(bench, "16_16_16",
+                                           str(tmp_path / "stages.csv"))
+        by_p = {p: (fft, xp) for _, _, p, _, fft, xp in rows}
+        assert by_p[4] == (8.0, 3.0)  # 2+6 FFT, 3 transpose
+        assert by_p[8] == (16.0, 6.0)
+        lines = open(str(tmp_path / "stages.csv")).read().splitlines()
+        assert lines[1] == ("variant,opt,cuda,P,total_ms,fft_ms,xpose_ms,"
+                            "fft_vs_P0,xpose_vs_P0")
+        rec8 = [l for l in lines if l.startswith("slab_default_default,0,0,8")]
+        assert rec8 and rec8[0].endswith("2.000,2.000")
+
+    def test_committed_stage_scalability_is_current(self):
+        """The committed cpumesh8 stage-decomposition CSV must match what
+        the reducer produces from the committed raw Timer data."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prefix = os.path.join(repo, "eval", "benchmarks", "cpumesh8")
+        committed = os.path.join(prefix, "eval",
+                                 "scalability_stages_256_256_256.csv")
+        with open(committed) as f:
+            want = f.read()
+        import tempfile
+        with tempfile.NamedTemporaryFile("r", suffix=".csv") as tmp:
+            evaluate.scalability_stages(prefix, "256_256_256", tmp.name)
+            assert tmp.read() == want
+
     def test_numerical_results(self, tmp_path):
         log = tmp_path / "run.out"
         log.write_text(

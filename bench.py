@@ -409,12 +409,15 @@ def _child_mesh() -> int:
     # anywhere in 0.5-1.4 (VERDICT r2 weak#1). Guarded: a precondition
     # failure must not discard the remaining mesh metrics.
     try:
-        # repeats=4/iterations=2 (vs the function defaults 5/3): the
-        # two-phase variant race roughly doubles chain count, and the mesh
-        # child must fit MESH_TIMEOUT_S with the geometry matrix still to
-        # run.
-        frac = microbench.transpose_fraction_chain(plan, spec, repeats=4,
-                                                   iterations=2)
+        # iterations=2 (vs the function default 3): the two-phase variant
+        # race roughly doubles chain count, and the mesh child must fit
+        # MESH_TIMEOUT_S with the geometry matrix still to run; the full
+        # 5 publication repeats stay (the published median/spread need
+        # them — measured 2026-07-30: whole parent ~142 s off-tunnel, so
+        # the headroom exists exactly where the statistics want it).
+        frac = microbench.transpose_fraction_chain(plan, spec, repeats=5,
+                                                   iterations=2,
+                                                   selection_repeats=3)
         if frac.get("degenerate"):
             # Every repeat's pair difference was swamped by noise: there
             # is no gate value to publish (NOT a fraction of 0 or 1).

@@ -524,12 +524,15 @@ def scalability_stages(prefix: str, size: str,
     where the ``_vs_P0`` columns are the stage time relative to the
     series' smallest P WITH stage marks (a fused single-program P=1 row
     records only the total; a zero baseline would nan out the whole
-    series). On a virtual mesh (all "devices" share one host's cores)
-    FFT time CANNOT scale down — compute is the same silicon — so the
-    meaningful axis is: does fft_vs_P0 stay ~1 while xpose_vs_P0 grows?
-    That attributes anti-scaling to exchange overhead added on shared
-    cores, separating it from any pipeline regression (which would
-    inflate fft_vs_P0 too).
+    series). Interpretation on a virtual mesh (all "devices" share one
+    host's cores): the two ratio columns separate failure modes rather
+    than promise a shape. Measured quiet-host behavior (round 4,
+    committed ``scalability_stages_256_256_256.csv``) has BOTH classes
+    shrinking with P — more executors soak otherwise-idle cores — while
+    a loaded host inflates both together (the round-3 tree's apparent
+    anti-scaling). A pipeline regression, by contrast, shows up in ONE
+    column (the exchange) against a flat-or-shrinking compute column;
+    that asymmetry is what this table exists to detect.
 
     ``data``: pre-scanned raw tree (``scan(prefix)``) so callers that
     already scanned (``main`` via ``reduce_prefix``) don't re-walk and

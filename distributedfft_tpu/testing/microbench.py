@@ -83,7 +83,8 @@ def wire_probe(shape, p: int, dtype=np.float32):
 
 
 def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
-                             iterations: int = 3, warmup: int = 1) -> Dict:
+                             iterations: int = 3, warmup: int = 1,
+                             selection_repeats: "int | None" = None) -> Dict:
     """North-star gate measurement: the pipeline transpose's achieved
     fraction of the raw collective ceiling, with ``fraction <= 1`` holding
     BY CONSTRUCTION in expectation (VERDICT r2: a gate whose measured
@@ -216,10 +217,12 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
 
     # SELECTION phase: race every variant; pick the winner by median
     # fraction. These samples are NOT published (max-of-noisy-medians is
-    # biased high — the publication phase re-measures fresh), so ranking
-    # needs fewer repeats than publication: 3 keeps a median while
-    # holding the two-phase gate inside the bench mesh child's timeout.
-    sel_n = min(repeats, 3)
+    # biased high — the publication phase re-measures fresh), so callers
+    # under a deadline (bench.py's mesh child) may rank with fewer
+    # repeats via ``selection_repeats``; default = the full ``repeats``
+    # so raising -i on a noisy host fixes selection-phase degeneracy too.
+    sel_n = repeats if selection_repeats is None else max(
+        1, min(selection_repeats, repeats))
     sel_fracs, _ = run_repeats(list(fns), sel_n)
     by_variant = {}
     for n, fs in sel_fracs.items():

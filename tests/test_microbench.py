@@ -91,6 +91,25 @@ def test_transpose_fraction_chain_is_a_gate(devices):
     lo, hi = r["fraction_spread"]
     assert lo <= r["fraction"] <= hi
     assert r["pipe_gb_per_s"] > 0 and r["raw_gb_per_s"] > 0
+    # Two-phase variant race (round 4): the published value names its
+    # rendering, and the selection-phase fractions ride along for
+    # visibility without being gate values.
+    assert r["variant"] in r["variants"]
+    assert set(r["variants"]) <= {"opt0", "opt1"}
+    for v in r["variants"].values():
+        assert 0.0 < v["fraction"] < 5.0
+
+
+def test_realigned_pack_shape_matches_transpose():
+    """The merged-leading ceiling layout must equal the shape the
+    realigned sender pack actually exchanges, for every (split, p)."""
+    from distributedfft_tpu.parallel.transpose import realigned_pack_shape
+
+    assert realigned_pack_shape((4, 16, 5), 1, 8) == (32, 2, 5)
+    assert realigned_pack_shape((4, 7, 16), 2, 8) == (32, 7, 2)
+    assert realigned_pack_shape((16, 3, 3), 0, 8) == (16, 3, 3)  # view
+    with pytest.raises(ValueError, match="divisible"):
+        realigned_pack_shape((4, 9, 5), 1, 8)
 
 
 def test_transpose_fraction_chain_rejects_bad_divisibility(devices):

@@ -199,6 +199,7 @@ def _child_tpu(deadline_s: int) -> int:
             # sizes back to the parent for a process-level retry.
             last_err = None
             size_mode = mode
+            fallback_reason = None
             attempts_left = 2
             while attempts_left > 0:
                 attempts_left -= 1
@@ -210,6 +211,23 @@ def _child_tpu(deadline_s: int) -> int:
                                            .random(shape).astype(np.float32))
                         fn1 = chaintimer.roundtrip_chain(1, shape, backend)
                         fnK = chaintimer.roundtrip_chain(k, shape, backend)
+                    elif size_mode == "forward-chunked":
+                        # Final HBM rung for the north-star cube: chunked
+                        # z/y stages (MEMORY_1024.md) — the only
+                        # single-program formulation known to fit 16 GB
+                        # at 1024^3. The plan path takes real
+                        # Config.fft_backend values only; in a
+                        # complex-broken session ("matmul-planes") the
+                        # chunked pipeline's complex intermediates use
+                        # the plain matmul backend (intermediates are
+                        # fine; only complex TRANSFERS poison — SKILL.md).
+                        be = "matmul" if backend == "matmul-planes" \
+                            else backend
+                        x = 0  # rng seed
+                        fn1 = chaintimer.chunked_forward_chain(1, n,
+                                                               backend=be)
+                        fnK = chaintimer.chunked_forward_chain(k, n,
+                                                               backend=be)
                     else:
                         # Large cubes / one-way modes: input generated ON
                         # device (a 1024^3 cube is 4 GiB; the tunnel moves
@@ -243,6 +261,15 @@ def _child_tpu(deadline_s: int) -> int:
                         if size_mode == "roundtrip" and n >= 1024:
                             # Roundtrip does not fit HBM (MEMORY_1024.md).
                             size_mode = "forward"
+                            fallback_reason = "roundtrip did not fit HBM"
+                            attempts_left = max(attempts_left, 2)
+                            continue
+                        if size_mode == "forward" and n >= 1024:
+                            # All-at-once forward doesn't fit either:
+                            # last rung is the chunked-stage plan.
+                            size_mode = "forward-chunked"
+                            fallback_reason = ("all-at-once forward did "
+                                               "not fit HBM")
                             attempts_left = max(attempts_left, 2)
                             continue
                         break
@@ -275,11 +302,13 @@ def _child_tpu(deadline_s: int) -> int:
             rec = {"per_iter_ms": round(per_ms, 4), "k": k}
             if size_mode != "roundtrip":
                 rec["mode"] = size_mode
-                if size_mode != mode:
-                    rec["mode_fallback"] = "roundtrip did not fit HBM"
+                if size_mode != mode and fallback_reason:
+                    rec["mode_fallback"] = fallback_reason
             if per_ms <= 0:
                 rec["degenerate"] = True
             else:
+                # One-way modes (forward / inverse / forward-chunked) do
+                # half a roundtrip's transform work.
                 flops = _flops_roundtrip(n) / (1 if size_mode == "roundtrip"
                                                else 2)
                 rec["gflops"] = round(flops / per_ms / 1e6, 1)
@@ -725,7 +754,9 @@ def main() -> int:
               "(baseline is a 256^3 roundtrip number, so no vs_baseline "
               "for this size/mode)")
         what = {"roundtrip": "R2C+C2R roundtrip", "forward": "R2C forward",
-                "inverse": "C2R inverse"}[mode]
+                "inverse": "C2R inverse",
+                "forward-chunked": "R2C forward (chunked stages)"}.get(
+                    mode, mode)
         metric = (f"single-chip {pick}^3 f32 {what} ms on "
                   f"{platform} [{backend} backend] {vs}")
         if pick != "256":

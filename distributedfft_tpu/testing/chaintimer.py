@@ -61,6 +61,31 @@ def roundtrip_chain(k: int, shape, backend: str, settings=None):
     return jax.jit(lambda x: jnp.sum(jnp.abs(lax.fori_loop(0, k, body, x))))
 
 
+def _accum_forward_chain(k: int, shape, fwd, rdt):
+    """Shared forward-direction chaining body: on-device input, scalar
+    accumulator folded into the next iteration's input as ``+ acc*1e-30``
+    (numerically negligible, but a real data dependency so XLA cannot
+    hoist or parallelize iterations). Single source of truth for the
+    chaining contract — ``directional_chain`` and
+    ``chunked_forward_chain`` must stay timing-comparable."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    scale = 1.0 / float(np.prod(shape))
+    tiny = 1e-30
+
+    def run(seed):
+        u = jax.random.uniform(jax.random.key(seed), tuple(shape), rdt)
+
+        def body(i, acc):
+            c = fwd(u + acc * tiny)
+            return acc + jnp.real(c)[0, 0, 0] * scale
+        return lax.fori_loop(0, k, body, jnp.zeros((), rdt))
+
+    return jax.jit(run)
+
+
 def directional_chain(k: int, shape, backend: str, direction: str,
                       settings=None, dtype=None):
     """Jitted scalar-fenced chain of ``k`` SINGLE-DIRECTION transforms
@@ -92,14 +117,14 @@ def directional_chain(k: int, shape, backend: str, direction: str,
     scale = 1.0 / float(np.prod(shape))
     tiny = 1e-30
 
+    if direction == "forward":
+        return _accum_forward_chain(
+            k, shape,
+            lambda v: lf.rfftn_3d(v, norm=FFTNorm.NONE, backend=backend,
+                                  settings=settings), rdt)
+
     def run(seed):
         u = jax.random.uniform(jax.random.key(seed), tuple(shape), rdt)
-        if direction == "forward":
-            def body(i, acc):
-                c = lf.rfftn_3d(u + acc * tiny, norm=FFTNorm.NONE,
-                                backend=backend, settings=settings)
-                return acc + jnp.real(c)[0, 0, 0] * scale
-            return lax.fori_loop(0, k, body, jnp.zeros((), rdt))
         if direction == "inverse":
             c0 = lf.rfftn_3d(u, norm=FFTNorm.NONE, backend=backend,
                              settings=settings)
@@ -118,6 +143,25 @@ def directional_chain(k: int, shape, backend: str, direction: str,
         return jnp.sum(jnp.abs(lax.fori_loop(0, k, body, u)))
 
     return jax.jit(run)
+
+
+def chunked_forward_chain(k: int, n: int, chunk: int = 8,
+                          backend: str = "matmul"):
+    """Forward chain over a CHUNKED single-device plan pipeline
+    (``Config.fft3d_chunk``): the z and y stages run in ``chunk``
+    sequential slices so the program's live intermediates fit a 16 GB
+    chip at 1024^3 (``eval/benchmarks/tpu_v5e/MEMORY_1024.md`` — the
+    all-at-once forward's intermediates do not). Same on-device-input +
+    scalar-accumulator chaining contract as ``directional_chain``."""
+    import jax.numpy as jnp
+
+    from ..models.slab import SlabFFTPlan
+    from ..params import Config, GlobalSize, SlabPartition
+
+    plan = SlabFFTPlan(GlobalSize(n, n, n), SlabPartition(1),
+                       Config(fft_backend=backend, fft3d_chunk=chunk))
+    return _accum_forward_chain(k, (n, n, n), plan.forward_fn(),
+                                jnp.float32)
 
 
 STAGES = ("rfft_z", "fft_y", "fft_x", "ifft_x", "ifft_y", "irfft_z")

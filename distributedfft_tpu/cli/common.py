@@ -81,14 +81,22 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                         help='"Peer2Peer" (XLA-scheduled redistribution) or '
                              '"All2All" (explicit collective), transpose 1')
         ap.add_argument("--send-method1", "-snd1", default="Sync",
-                        help="Sync | Streams | MPI_Type (layout hint, kept "
-                             "for reference CLI compatibility)")
+                        help="Sync (monolithic exchange) | Streams (chunked/"
+                             "pipelined transpose, see --streams-chunks) | "
+                             "MPI_Type (alias of Sync)")
         ap.add_argument("--comm-method2", "-comm2", default=None,
                         help="same as --comm-method1 for transpose 2")
         ap.add_argument("--send-method2", "-snd2", default=None)
     else:
         ap.add_argument("--comm-method", "-comm", default="Peer2Peer")
-        ap.add_argument("--send-method", "-snd", default="Sync")
+        ap.add_argument("--send-method", "-snd", default="Sync",
+                        help="Sync (monolithic exchange) | Streams (chunked/"
+                             "pipelined transpose, see --streams-chunks) | "
+                             "MPI_Type (alias of Sync)")
+    ap.add_argument("--streams-chunks", type=int, default=None,
+                    help="piece count for the Streams pipelined transpose "
+                         "(default 4; ignored unless a send method is "
+                         "Streams)")
 
 
 def maybe_autotune_comm(args, kind, global_size, partition, cfg,

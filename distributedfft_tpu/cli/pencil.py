@@ -49,7 +49,7 @@ def main(argv=None) -> int:
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
-        fft_backend=args.fft_backend)
+        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks)
     part = pm.PencilPartition(args.partition1, args.partition2)
     cfg = maybe_autotune_comm(args, "pencil", g, part, cfg,
                               dims=args.fft_dim)

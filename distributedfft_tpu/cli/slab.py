@@ -48,7 +48,7 @@ def main(argv=None) -> int:
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
-        fft_backend=args.fft_backend)
+        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks)
     part = pm.SlabPartition(p)
     cfg = maybe_autotune_comm(args, "slab", g, part, cfg,
                               sequence=args.sequence)

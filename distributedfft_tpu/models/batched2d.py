@@ -32,7 +32,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
-from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
+from ..parallel.transpose import (all_to_all_transpose, concat_axis_chunks,
+                                  pad_axis_to, slice_axis_to,
+                                  split_axis_chunks)
 from .base import _with_pad, jit_stages
 
 
@@ -317,21 +319,47 @@ class Batched2DFFTPlan:
         two shard_map stages whose boundary sharding change makes XLA's
         SPMD partitioner insert and schedule the collective. (Without this
         split the sweep's comm axis would compare two runs of the same
-        program.)"""
+        program.)
+
+        ``SendMethod.STREAMS`` chunks along the batch axis (the one axis
+        the 2D transform and the transpose both leave untouched) into K
+        independent exchange->FFT piece chains, exactly like the slab
+        engine's pipelined rendering."""
         first, xpose, last = self._slab_parts(forward)
         mesh = self.mesh
         if forward:
             in_spec, out_spec = self._in_spec, self._out_spec
         else:
             in_spec, out_spec = self._out_spec, self._in_spec
+        streams = self.config.send_method is pm.SendMethod.STREAMS
+        k = self.config.resolved_streams_chunks()
         if self.config.comm_method is pm.CommMethod.ALL2ALL:
-            return (jax.shard_map(lambda v: last(xpose(first(v))), mesh=mesh,
-                                  in_specs=in_spec, out_specs=out_spec),
+            if streams:
+                def body(v):
+                    c = first(v)
+                    return concat_axis_chunks(
+                        [last(xpose(p))
+                         for p in split_axis_chunks(c, 0, k)], 0)
+            else:
+                def body(v):
+                    return last(xpose(first(v)))
+            return (jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                                  out_specs=out_spec),
                     in_spec, out_spec)
         stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
                                out_specs=in_spec)
         stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
                                out_specs=out_spec)
+        if streams:
+            boundary = NamedSharding(mesh, out_spec)
+
+            def pure(v):
+                y = stage1(v)
+                pieces = [jax.lax.with_sharding_constraint(p, boundary)
+                          for p in split_axis_chunks(y, 0, k)]
+                return stage2(concat_axis_chunks(pieces, 0))
+
+            return pure, in_spec, out_spec
         return (lambda v: stage2(stage1(v)), in_spec, out_spec)
 
     # -- per-phase staged execution (benchmark timer support; same hooks

@@ -52,7 +52,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
-from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
+from ..parallel.transpose import (all_to_all_transpose, concat_axis_chunks,
+                                  pad_axis_to, slice_axis_to,
+                                  split_axis_chunks)
 from .base import DistFFTPlan, _with_pad
 
 P1_AXIS, P2_AXIS = PENCIL_AXES
@@ -342,30 +344,49 @@ class PencilFFTPlan(DistFFTPlan):
     # -- pipeline builders -------------------------------------------------
 
     def _fwd_segments(self, dims: int):
-        """(segments, start_spec) of the forward pipeline."""
+        """(segments, start_spec) of the forward pipeline.
+
+        Each transpose leaves exactly one axis untouched (t1 moves z<->y,
+        free x; t2 moves y<->x, free z) and the FFT stage that follows it
+        never transforms that axis — so under ``SendMethod.STREAMS`` the
+        (transpose, next FFT) pair chunks along the free axis into K
+        independent exchange->FFT piece chains (``_attach``), the pencil
+        rendering of the reference's per-transpose Streams engine
+        (``src/pencil/mpicufft_pencil.cpp:678-1482`` send methods)."""
         s1, t1, s2, t2, s3 = self._fwd_parts(dims)
         segments = [(s1, self._in_spec)]
         if dims >= 2:
-            self._append(segments, self.config.comm_method, t1, self._mid_spec)
-            segments.append((s2, self._mid_spec))
+            if not self._attach(segments, self.config.comm_method,
+                                self.config.send_method, t1, s2,
+                                self._mid_spec, ca=0):
+                segments.append((s2, self._mid_spec))
         if dims >= 3:
-            self._append(segments, self.config.resolved_comm2(), t2,
-                         self._out_spec)
-            segments.append((s3, self._out_spec))
+            if not self._attach(segments, self.config.resolved_comm2(),
+                                self.config.resolved_snd2(), t2, s3,
+                                self._out_spec, ca=2):
+                segments.append((s3, self._out_spec))
         return segments, self._in_spec
 
     def _inv_segments(self, dims: int):
-        """(segments, start_spec) of the inverse pipeline."""
+        """(segments, start_spec) of the inverse pipeline (free axes mirror
+        the forward: t2b moves x<->y, free z; t1b moves y<->z, free x)."""
         i3, t2b, i2, t1b, i1 = self._inv_parts(dims)
         segments: List = []
         if dims >= 3:
             segments.append((i3, self._out_spec))
-            self._append(segments, self.config.resolved_comm2(), t2b,
-                         self._mid_spec)
+            if self._attach(segments, self.config.resolved_comm2(),
+                            self.config.resolved_snd2(), t2b, i2,
+                            self._mid_spec, ca=2):
+                i2 = None  # consumed into the chunked segment
         if dims >= 2:
-            segments.append((i2, self._mid_spec))
-            self._append(segments, self.config.comm_method, t1b, self._in_spec)
-        segments.append((i1, self._in_spec))
+            if i2 is not None:
+                segments.append((i2, self._mid_spec))
+            if self._attach(segments, self.config.comm_method,
+                            self.config.send_method, t1b, i1,
+                            self._in_spec, ca=0):
+                i1 = None
+        if i1 is not None:
+            segments.append((i1, self._in_spec))
         start = {3: self._out_spec, 2: self._mid_spec, 1: self._in_spec}[dims]
         return segments, start
 
@@ -476,16 +497,43 @@ class PencilFFTPlan(DistFFTPlan):
 
 
 
-    @staticmethod
-    def _append(segments, comm: pm.CommMethod, a2a, spec_after):
-        """Attach a transpose: explicit collective fused into the previous
-        segment (ALL2ALL), or a segment break so GSPMD inserts the
-        redistribution at the boundary (PEER2PEER)."""
+    def _attach(self, segments, comm: pm.CommMethod, snd: pm.SendMethod,
+                a2a, nxt, spec_after, ca: int) -> bool:
+        """Attach a transpose to the segment list.
+
+        ALL2ALL + SYNC: explicit collective fused into the previous segment.
+        ALL2ALL + STREAMS: the previous segment is extended with K
+        independent (transpose -> ``nxt``) piece chains along free axis
+        ``ca``; returns True to signal ``nxt`` was consumed.
+        PEER2PEER + SYNC: a segment break so GSPMD inserts the resharding
+        collective at the boundary.
+        PEER2PEER + STREAMS: a chunked break — the boundary reshards K
+        pieces independently (per-piece ``with_sharding_constraint``), so
+        GSPMD emits K smaller collectives it may overlap with neighbours.
+        """
+        streams = snd is pm.SendMethod.STREAMS
         if comm is pm.CommMethod.ALL2ALL:
             prev_fn, _ = segments[-1]
+            if streams:
+                k = self.config.resolved_streams_chunks()
+
+                def seg(c, f=prev_fn, a2a=a2a, nxt=nxt, ca=ca, k=k):
+                    c = f(c)
+                    return concat_axis_chunks(
+                        [nxt(a2a(p)) for p in split_axis_chunks(c, ca, k)],
+                        ca)
+
+                segments[-1] = (seg, spec_after)
+                return True
             segments[-1] = (lambda c, f=prev_fn: a2a(f(c)), spec_after)
-        else:
-            segments.append(("BREAK", spec_after))
+            return False
+        if streams:
+            segments.append((("CHUNKED_BREAK", ca,
+                              self.config.resolved_streams_chunks()),
+                             spec_after))
+            return False
+        segments.append(("BREAK", spec_after))
+        return False
 
     def _compose(self, segments, in_spec):
         """Fuse consecutive segments that share a shard_map into staged
@@ -512,6 +560,23 @@ class PencilFFTPlan(DistFFTPlan):
         for fn, spec in segments:
             if fn == "BREAK":
                 flush()
+                cur_fns = []
+                cur_in = spec
+                cur_out = spec
+            elif isinstance(fn, tuple) and fn[0] == "CHUNKED_BREAK":
+                # PEER2PEER + STREAMS boundary: reshard K pieces of the
+                # global array independently so GSPMD emits K smaller
+                # collectives instead of one monolithic redistribution.
+                flush()
+                _, ca, k = fn
+                sh = NamedSharding(mesh, spec)
+
+                def reshard(x, sh=sh, ca=ca, k=k):
+                    return concat_axis_chunks(
+                        [jax.lax.with_sharding_constraint(p, sh)
+                         for p in split_axis_chunks(x, ca, k)], ca)
+
+                stages.append(reshard)
                 cur_fns = []
                 cur_in = spec
                 cur_out = spec

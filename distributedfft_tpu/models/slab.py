@@ -59,7 +59,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
-from ..parallel.transpose import all_to_all_transpose, pad_axis_to, slice_axis_to
+from ..parallel.transpose import (all_to_all_transpose, concat_axis_chunks,
+                                  pad_axis_to, slice_axis_to,
+                                  split_axis_chunks)
 from .base import DistFFTPlan, _with_pad
 
 
@@ -255,25 +257,41 @@ class SlabFFTPlan(DistFFTPlan):
     # lets the stage boundary trigger the collective; forward_stages()/
     # inverse_stages() jit them individually for per-phase timing.
 
-    def _xpose_bodies(self, realigned=None):
+    def _streams_chunk_axis(self) -> int:
+        """The axis the STREAMS pipelined transpose chunks along: the one
+        axis involved in neither side of the exchange (slab transposes move
+        ``split_axis`` <-> 0, leaving exactly one of {1, 2} free)."""
+        return next(a for a in (1, 2) if a != self._seq.split_axis)
+
+    def _xpose_bodies(self, realigned=None, chunks: Optional[int] = None):
         """The pipeline's own transpose bodies ``(forward, inverse)`` for a
         given layout rendering (``realigned=None`` -> this plan's
         ``config.opt``). Single source of truth for what the slab exchange
         does — the fraction-gate microbench times exactly these, so the gate
-        cannot drift from the shipped pipeline."""
+        cannot drift from the shipped pipeline.
+
+        ``chunks`` > 1 renders each transpose as that many independent
+        per-piece collectives along the free axis (the exchange half of the
+        STREAMS engine, without the interleaved FFTs — what the fraction
+        chain races to see whether chunked exchanges alone pay or win)."""
         if realigned is None:
             realigned = self.config.opt == 1
         sa = self._seq.split_axis
+        ca = self._streams_chunk_axis()
 
-        def fwd(cl):
-            return all_to_all_transpose(cl, SLAB_AXIS, sa, 0,
+        def one(cl, split, concat):
+            return all_to_all_transpose(cl, SLAB_AXIS, split, concat,
                                         realigned=realigned)
 
-        def inv(cl):
-            return all_to_all_transpose(cl, SLAB_AXIS, 0, sa,
-                                        realigned=realigned)
+        if chunks is None or chunks <= 1:
+            return (lambda cl: one(cl, sa, 0)), (lambda cl: one(cl, 0, sa))
 
-        return fwd, inv
+        def chunked(cl, split, concat):
+            return concat_axis_chunks(
+                [one(p, split, concat)
+                 for p in split_axis_chunks(cl, ca, chunks)], ca)
+
+        return (lambda cl: chunked(cl, sa, 0)), (lambda cl: chunked(cl, 0, sa))
 
     def _fwd_parts(self):
         s, norm, g = self._seq, self.config.norm, self.global_size
@@ -334,6 +352,80 @@ class SlabFFTPlan(DistFFTPlan):
 
         return first, xpose, last
 
+    # -- STREAMS (chunked / software-pipelined) bodies ---------------------
+    # The TPU rendering of the reference's Streams send engine (per-peer
+    # packs on CUDA streams + callback thread + MPI_Isend,
+    # src/slab/default/mpicufft_slab.cpp:343-448): split the local block
+    # into K pieces along the one axis the exchange leaves free, and give
+    # each piece its own transpose -> FFT chain. The K chains share no
+    # data, so the scheduler may run piece i's collective concurrently
+    # with piece i-1's FFT (async all-to-all-start/done pairs on TPU).
+    # FFTs along the chunk axis itself cannot be chunked and run once on
+    # the re-assembled block; separable DFT axes commute, so hoisting them
+    # across the per-chunk transforms preserves the result exactly.
+
+    def _streams_split(self):
+        """(chunk_axis, chunks, per-chunk post axes, after-concat post
+        axes) — the static plan of the STREAMS pipeline."""
+        ca = self._streams_chunk_axis()
+        k = self.config.resolved_streams_chunks()
+        per_chunk = tuple(a for a in self._seq.post_axes if a != ca)
+        after = tuple(a for a in self._seq.post_axes if a == ca)
+        return ca, k, per_chunk, after
+
+    def _streams_fwd_body(self):
+        """Local forward body for ALL2ALL + STREAMS: first-stage FFTs, then
+        K independent (transpose -> post-FFT) piece chains."""
+        norm, g = self.config.norm, self.global_size
+        be, st = self.config.fft_backend, self._mxu_st
+        ca, k, per_chunk, after = self._streams_split()
+        first = self._fwd_parts()[0]
+        xpose = self._xpose_bodies()[0]
+        nx = g.nx
+
+        def body(xl):
+            c = first(xl)
+            outs = []
+            for piece in split_axis_chunks(c, ca, k):
+                y = xpose(piece)
+                y = slice_axis_to(y, 0, nx)
+                for a in per_chunk:
+                    y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
+                outs.append(y)
+            c = concat_axis_chunks(outs, ca)
+            for a in after:
+                c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
+            return c
+
+        return body
+
+    def _streams_inv_body(self):
+        """Local inverse body for ALL2ALL + STREAMS: mirror of
+        ``_streams_fwd_body`` (chunk-axis inverse FFT first, then K
+        independent (inverse-FFT -> transpose-back) piece chains, then the
+        shared last stage)."""
+        norm = self.config.norm
+        be, st = self.config.fft_backend, self._mxu_st
+        ca, k, per_chunk, after = self._streams_split()
+        xpose_inv = self._xpose_bodies()[1]
+        last = self._inv_parts()[2]
+        nx_pad = self._nx_pad
+
+        def body(cl):
+            c = cl
+            for a in after:
+                c = lf.ifft(c, axis=a, norm=norm, backend=be, settings=st)
+            outs = []
+            for piece in split_axis_chunks(c, ca, k):
+                y = piece
+                for a in reversed(per_chunk):
+                    y = lf.ifft(y, axis=a, norm=norm, backend=be, settings=st)
+                y = pad_axis_to(y, 0, nx_pad)
+                outs.append(xpose_inv(y))
+            return last(concat_axis_chunks(outs, ca))
+
+        return body
+
     # -- pipeline builders -------------------------------------------------
 
     def _build_r2c(self):
@@ -341,24 +433,27 @@ class SlabFFTPlan(DistFFTPlan):
             return (self._fft3d_c2c(forward=True) if self.transform == "c2c"
                     else self._fft3d_r2c())
         return self._assemble(self._fwd_parts(), self._in_spec, self._out_spec,
-                              self.config.comm_method)
+                              self.config.comm_method, forward=True)
 
     def _build_c2r(self):
         if self.fft3d:
             return (self._fft3d_c2c(forward=False) if self.transform == "c2c"
                     else self._fft3d_c2r())
         return self._assemble(self._inv_parts(), self._out_spec, self._in_spec,
-                              self.config.comm_method)
+                              self.config.comm_method, forward=False)
 
-    def _assemble(self, parts, in_spec, out_spec, comm: pm.CommMethod):
+    def _assemble(self, parts, in_spec, out_spec, comm: pm.CommMethod,
+                  forward: bool = True):
         """Compose (first, xpose, last) into one jitted program (the pure
         composition from ``_assemble_pure`` with in/out shardings)."""
-        pure = self._assemble_pure(parts, in_spec, out_spec, comm)
+        pure = self._assemble_pure(parts, in_spec, out_spec, comm,
+                                   forward=forward)
         mesh = self.mesh
         return jax.jit(pure, in_shardings=NamedSharding(mesh, in_spec),
                        out_shardings=NamedSharding(mesh, out_spec))
 
-    def _assemble_pure(self, parts, in_spec, out_spec, comm: pm.CommMethod):
+    def _assemble_pure(self, parts, in_spec, out_spec, comm: pm.CommMethod,
+                       forward: bool = True):
         """Compose (first, xpose, last) into one pure callable.
 
         ALL2ALL: a single shard_map containing the explicit collective.
@@ -366,17 +461,40 @@ class SlabFFTPlan(DistFFTPlan):
         sharding change at the stage boundary makes XLA's SPMD partitioner
         insert and schedule the collective (its latency-hiding scheduler is
         the analog of the reference's Isend/Irecv + callback-thread overlap
-        engine)."""
+        engine).
+
+        ``SendMethod.STREAMS`` swaps in the chunked pipelined rendering:
+        ALL2ALL uses the ``_streams_*_body`` per-piece chains; PEER2PEER
+        splits the stage boundary itself into per-piece reshards
+        (``with_sharding_constraint`` per chunk), so GSPMD emits K smaller
+        collectives it may overlap with the neighbouring stages."""
         first, xpose, last = parts
         mesh = self.mesh
+        streams = self.config.send_method is pm.SendMethod.STREAMS
         if comm is pm.CommMethod.ALL2ALL:
+            if streams:
+                body = (self._streams_fwd_body() if forward
+                        else self._streams_inv_body())
+                return jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                                     out_specs=out_spec)
             return jax.shard_map(lambda xl: last(xpose(first(xl))), mesh=mesh,
                                  in_specs=in_spec, out_specs=out_spec)
         stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
                                out_specs=in_spec)
         stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
                                out_specs=out_spec)
-        return lambda x: stage2(stage1(x))
+        if not streams:
+            return lambda x: stage2(stage1(x))
+        ca, k, _, _ = self._streams_split()
+        boundary = NamedSharding(mesh, out_spec)
+
+        def pure(x):
+            y = stage1(x)
+            pieces = [jax.lax.with_sharding_constraint(p, boundary)
+                      for p in split_axis_chunks(y, ca, k)]
+            return stage2(concat_axis_chunks(pieces, ca))
+
+        return pure
 
     def forward_fn(self):
         """Pure forward pipeline (``DistFFTPlan.forward_fn`` contract).
@@ -391,7 +509,8 @@ class SlabFFTPlan(DistFFTPlan):
             else:
                 pure = self._assemble_pure(self._fwd_parts(), self._in_spec,
                                            self._out_spec,
-                                           self.config.comm_method)
+                                           self.config.comm_method,
+                                           forward=True)
             self._fwd_pure = _with_pad(pure, self.input_shape,
                                        self.input_padded_shape)
         return self._fwd_pure
@@ -406,7 +525,8 @@ class SlabFFTPlan(DistFFTPlan):
             else:
                 pure = self._assemble_pure(self._inv_parts(), self._out_spec,
                                            self._in_spec,
-                                           self.config.comm_method)
+                                           self.config.comm_method,
+                                           forward=False)
             self._inv_pure = _with_pad(pure, self.output_shape,
                                        self.output_padded_shape)
         return self._inv_pure

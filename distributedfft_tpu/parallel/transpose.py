@@ -43,6 +43,34 @@ def slice_axis_to(x, axis: int, target: int):
     return lax.slice_in_dim(x, 0, target, axis=axis)
 
 
+def chunk_slices(ext: int, k: int):
+    """``(start, size)`` pairs splitting an axis of extent ``ext`` into
+    ``min(k, ext)`` near-equal pieces (remainder spread over the leading
+    pieces) — the static chunk table of the STREAMS pipelined transpose."""
+    k = max(1, min(k, ext))
+    q, r = divmod(ext, k)
+    out, off = [], 0
+    for i in range(k):
+        sz = q + (1 if i < r else 0)
+        out.append((off, sz))
+        off += sz
+    return out
+
+
+def split_axis_chunks(x, axis: int, k: int):
+    """Split ``x`` into ``min(k, extent)`` near-equal pieces along ``axis``
+    (static slicing; uneven tail sizes allowed)."""
+    return [lax.slice_in_dim(x, off, off + sz, axis=axis)
+            for off, sz in chunk_slices(x.shape[axis], k)]
+
+
+def concat_axis_chunks(pieces, axis: int):
+    """Reassemble ``split_axis_chunks`` pieces (single piece passes through
+    untouched — the split/join contract lives in one place)."""
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces,
+                                                              axis=axis)
+
+
 def realigned_pack_shape(shape, split_axis: int, p: int):
     """Shape the realigned sender pack exchanges (the merged-leading layout
     of ``all_to_all_transpose(..., realigned=True)``'s PURE collective) —

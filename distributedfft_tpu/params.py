@@ -15,10 +15,17 @@ On TPU the comm/send matrix collapses into *how the XLA program is built*:
   global-view ops with ``with_sharding_constraint`` between stages and XLA
   chooses the collective schedule (its latency-hiding scheduler plays the
   role of the reference's hand-rolled Isend/Irecv overlap engine).
-* ``SendMethod`` survives as a *layout hint*: ``MPI_TYPE`` (zero-copy strided
-  datatypes) and ``STREAMS`` (pipelined packing) have no host analog under
-  XLA -- packing is a fused transpose -- so all three values are accepted for
-  API compatibility and recorded for benchmarking labels.
+* ``SendMethod.STREAMS`` -> the chunked/software-pipelined transpose: the
+  local block is split into ``Config.streams_chunks`` pieces along an axis
+  untouched by the exchange, and each piece runs its own
+  FFT -> collective -> FFT chain. The chains are data-independent, so XLA's
+  async collectives (``all-to-all-start/done`` on TPU) can overlap piece
+  i's exchange with piece i-1's compute — the role of the reference's
+  Streams engine (per-peer packs on CUDA streams + callback thread +
+  ``MPI_Isend``, ``src/slab/default/mpicufft_slab.cpp:343-448``).
+  ``SYNC`` is the monolithic single-collective pipeline; ``MPI_TYPE``
+  (zero-copy strided datatypes) has no analog under XLA -- packing is a
+  fused transpose -- and is accepted as a benchmarking label alias of SYNC.
 
 Everything here is pure Python (no devices required), mirroring the
 reference's L1b layer which is header-only.
@@ -57,7 +64,9 @@ class CommMethod(enum.Enum):
 
 
 class SendMethod(enum.Enum):
-    """Packing strategy (reference ``params.hpp:87-89``); a layout hint here."""
+    """Packing strategy (reference ``params.hpp:87-89``). ``STREAMS``
+    selects the chunked/software-pipelined transpose (see module
+    docstring); ``SYNC``/``MPI_TYPE`` are the monolithic pipeline."""
 
     SYNC = "Sync"
     STREAMS = "Streams"
@@ -266,6 +275,12 @@ class Config:
     analog of the reference's cuFFT-plan choice at L0
     (``include/cufft.hpp:23-61``).
 
+    ``streams_chunks`` sets how many pieces the ``SendMethod.STREAMS``
+    pipelined transpose splits the local block into (None -> 4). Ignored
+    unless the plan's (resolved) send method is STREAMS; clamped to the
+    chunk axis extent at trace time. More chunks = more overlap windows
+    but smaller (less bandwidth-efficient) exchanges.
+
     ``fft3d_chunk`` bounds the SINGLE-DEVICE 3D path's peak memory: the
     z+y stages run as ``lax.map`` over that many leading-axis chunks, so
     the four-step relayout temporaries scale with a chunk instead of the
@@ -302,6 +317,7 @@ class Config:
     mxu_karatsuba: Optional[bool] = None
     mxu_fourstep_einsum: Optional[bool] = None
     fft3d_chunk: Optional[int] = None
+    streams_chunks: Optional[int] = None
 
     def __post_init__(self):
         from .ops.fft import validate_backend  # lazy: ops.fft imports params
@@ -316,6 +332,15 @@ class Config:
             raise ValueError(
                 f"fft3d_chunk must be a positive int or None, "
                 f"got {self.fft3d_chunk!r}")
+        if self.streams_chunks is not None and (
+                not isinstance(self.streams_chunks, int)
+                or self.streams_chunks < 1):
+            # >= 1, not >= 2: the knob is documented as ignored unless the
+            # send method is STREAMS, and chunks=1 degrades gracefully to
+            # the monolithic exchange (chunk_slices clamps anyway).
+            raise ValueError(
+                f"streams_chunks must be a positive int or None, "
+                f"got {self.streams_chunks!r}")
 
     def mxu_settings(self):
         """The plan's ``mxu_fft.MXUSettings``, or None when every knob is
@@ -348,3 +373,7 @@ class Config:
 
     def resolved_snd2(self) -> SendMethod:
         return self.send_method2 if self.send_method2 is not None else self.send_method
+
+    def resolved_streams_chunks(self) -> int:
+        """Chunk count for the STREAMS pipelined transpose (None -> 4)."""
+        return self.streams_chunks if self.streams_chunks is not None else 4

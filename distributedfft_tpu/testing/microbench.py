@@ -82,9 +82,103 @@ def wire_probe(shape, p: int, dtype=np.float32):
     return time_window, info
 
 
+def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
+                 repeats: int = 5, iterations: int = 3, warmup: int = 1,
+                 backend: str = "xla", sequence: str = "ZY_Then_X",
+                 comm: str = "All2All", opt: int = 1) -> Dict:
+    """Race the monolithic slab pipeline (``SendMethod.SYNC`` — one
+    collective per transpose) against the STREAMS chunked/software-pipelined
+    rendering (K independent per-piece FFT->exchange->FFT chains), measuring
+    whether splitting the exchange buys compute/communication overlap — the
+    question the reference answers with its Streams engine
+    (``src/slab/default/mpicufft_slab.cpp:343-448``) and SURVEY §7 says to
+    measure, not assume.
+
+    Each variant times a K-chained forward+inverse roundtrip via the
+    ``(t_K - t_1)/(K-1)`` pair difference (chaintimer contract), all within
+    the same repeat so drift hits every variant equally. The result also
+    carries per-variant HLO attribution: counts of ``all-to-all`` ops and of
+    async ``all-to-all-start`` forms in the compiled module — on a backend
+    whose collectives lower synchronously (CPU) the chunked variant CANNOT
+    overlap, and the counts say so; async starts are the evidence that the
+    scheduler may hide exchange latency behind the neighbouring FFTs.
+    """
+    import jax.lax as lax
+
+    from .. import params as pm
+    from ..models.slab import SlabFFTPlan
+
+    g = pm.GlobalSize(*global_shape)
+    scale = 1.0 / float(g.n_total)
+    variants = [("sync", None)] + [(f"streams{c}", c) for c in chunk_counts]
+    fns, hlo = {}, {}
+    for name, chunks in variants:
+        cfg = pm.Config(comm_method=pm.CommMethod.parse(comm),
+                        send_method=(pm.SendMethod.SYNC if chunks is None
+                                     else pm.SendMethod.STREAMS),
+                        streams_chunks=chunks, fft_backend=backend, opt=opt)
+        plan = SlabFFTPlan(g, pm.SlabPartition(p), cfg, sequence=sequence)
+        fwd, inv = plan.forward_fn(), plan.inverse_fn()
+        ishard = NamedSharding(plan.mesh, plan._in_spec)
+
+        def chain(kk, fwd=fwd, inv=inv):
+            def run(v):
+                return lax.fori_loop(
+                    0, kk, lambda i, w: inv(fwd(w)) * scale, v)
+            return jax.jit(run, in_shardings=ishard, out_shardings=ishard)
+
+        x = jax.device_put(
+            np.random.default_rng(0).random(
+                plan.input_padded_shape).astype(np.float32), ishard)
+        f1, fK = chain(1), chain(k)
+        compiled = f1.lower(x).compile()
+        txt = compiled.as_text()
+        # Op INSTANCES (`<op>(` with the opening paren), not substring hits:
+        # "all-to-all(" does not match the async "all-to-all-start(" form.
+        hlo[name] = {"all_to_all": txt.count(" all-to-all("),
+                     "all_to_all_start": txt.count(" all-to-all-start(")}
+        jax.block_until_ready(fK(x))  # compile + warm the K-chain too
+        fns[name] = (f1, fK, x)
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    times = {name: [] for name, _ in variants}
+    for _ in range(repeats):
+        for name in times:
+            f1, fK, x = fns[name]
+            tK = _time_fn(fK, x, iterations, warmup)
+            t1 = _time_fn(f1, x, iterations, warmup)
+            d = (tK - t1) / (k - 1)
+            if d > 0:
+                times[name].append(d)
+    out = {"shape": list(global_shape), "p": p, "k": k, "repeats": repeats,
+           "backend": backend, "sequence": sequence, "comm": comm,
+           "opt": opt, "variants": {}}
+    for name in times:
+        ts = sorted(times[name])
+        rec = {"hlo": hlo[name]}
+        if ts:
+            rec["per_iter_ms"] = round(med(ts) * 1e3, 3)
+            rec["spread_ms"] = [round(ts[0] * 1e3, 3),
+                                round(ts[-1] * 1e3, 3)]
+        else:
+            rec["degenerate"] = True
+        out["variants"][name] = rec
+    timed = {n: v["per_iter_ms"] for n, v in out["variants"].items()
+             if "per_iter_ms" in v}
+    if timed:
+        best = min(timed, key=timed.get)
+        out["winner"] = best
+        if "sync" in timed and timed["sync"] > 0:
+            out["best_vs_sync"] = round(timed["sync"] / timed[best], 4)
+    return out
+
+
 def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
                              iterations: int = 3, warmup: int = 1,
-                             selection_repeats: "int | None" = None) -> Dict:
+                             selection_repeats: "int | None" = None,
+                             streams_variants=()) -> Dict:
     """North-star gate measurement: the pipeline transpose's achieved
     fraction of the raw collective ceiling, with ``fraction <= 1`` holding
     BY CONSTRUCTION in expectation (VERDICT r2: a gate whose measured
@@ -144,8 +238,8 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
         return jax.jit(sm, in_shardings=NamedSharding(mesh, ispec),
                        out_shardings=NamedSharding(mesh, ispec))
 
-    def pipe_pair(realigned):
-        xf, xi = plan._xpose_bodies(realigned)
+    def pipe_pair(realigned, chunks=None):
+        xf, xi = plan._xpose_bodies(realigned, chunks=chunks)
         return lambda w: xi(xf(w))
 
     def pure_pair(w):
@@ -173,6 +267,13 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
            "opt1": (chained(pipe_pair(True), 1), chained(pipe_pair(True), k)),
            "raw": (chained(pure_pair, 1), chained(pure_pair, k)),
            "raw_merged": (chained(pure_pair, 1), chained(pure_pair, k))}
+    # Chunked-exchange (STREAMS) renderings of the realigned transpose:
+    # raced in selection like any variant; a pure-transpose chain has no
+    # FFT to overlap with, so this isolates the cost/benefit of splitting
+    # the collective itself (overlap_race measures the full-pipeline case).
+    for c in streams_variants:
+        pp = pipe_pair(True, chunks=c)
+        fns[f"opt1s{c}"] = (chained(pp, 1), chained(pp, k))
     args = {n: merged_val if n == "raw_merged" else spec_val for n in fns}
     for name, (f1, fK) in fns.items():  # compile + warm all chains up front
         jax.block_until_ready(f1(args[name]))

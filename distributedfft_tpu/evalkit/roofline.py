@@ -18,6 +18,15 @@ backend's default precision is ``HIGH`` = 3-pass bf16 emulation of f32
 Reference anchor: the reference derives GPU efficiency from cuFFT's nominal
 flops only (``/root/reference/eval/complete/scalability.py``); a
 hardware-true denominator is an extension.
+
+DEFAULT-SETTINGS ASSUMPTION: the MAC model mirrors ``ops/mxu_fft.py`` at
+its default ``MXUSettings`` only. Two non-default toggles change the MACs
+actually issued — ``karatsuba=True`` lowers each complex dot to 3 real
+matmuls plus extra adds, and ``fourstep_einsum=True`` makes ``_rfft_last``
+skip the real-matmul fast path — and neither is recorded in the measured
+CSV, so ``_BACKENDS`` maps only default-settings backend labels and any
+row measured under those toggles must not be fed to ``roofline_rows``
+(it would be silently miscounted, not skipped).
 """
 
 from __future__ import annotations
@@ -45,7 +54,7 @@ def effective_peak_tflops(precision: str = "high") -> float:
 # ---------------------------------------------------------------------------
 
 
-def macs_c2c_axis(n: int, direct_max: int = DIRECT_MAX,
+def macs_c2c_axis(n: int, direct_max: int = DIRECT_MAX, *,
                   radix2: bool = False, complex_mults: int = 4) -> float:
     """MXU MACs per element for one C2C pass along an axis of length ``n``
     (``_fft_last``): direct = one complex matmul lowered to
@@ -60,17 +69,20 @@ def macs_c2c_axis(n: int, direct_max: int = DIRECT_MAX,
     cheaper than 4 — so the two models BRACKET the hardware count, and
     the roofline reports both."""
     if radix2 and n > _R2_BASE and n % 2 == 0:
-        return macs_c2c_axis(n // 2, direct_max, radix2, complex_mults)
+        return macs_c2c_axis(n // 2, direct_max, radix2=radix2,
+                             complex_mults=complex_mults)
     if n <= direct_max:
         return float(complex_mults) * n
     n1, n2 = _split(n)
     if n1 == 1:
         return float(complex_mults) * n
-    return (macs_c2c_axis(n2, direct_max, radix2, complex_mults)
-            + macs_c2c_axis(n1, direct_max, radix2, complex_mults))
+    return (macs_c2c_axis(n2, direct_max, radix2=radix2,
+                          complex_mults=complex_mults)
+            + macs_c2c_axis(n1, direct_max, radix2=radix2,
+                            complex_mults=complex_mults))
 
 
-def macs_r2c_axis(n: int, direct_max: int = DIRECT_MAX,
+def macs_r2c_axis(n: int, direct_max: int = DIRECT_MAX, *,
                   complex_mults: int = 4) -> float:
     """MACs per INPUT element for the R2C first pass (``_rfft_last``):
     direct = 2 real n->n_out matmuls (2·n_out MACs/element); four-step =
@@ -86,8 +98,8 @@ def macs_r2c_axis(n: int, direct_max: int = DIRECT_MAX,
                                     complex_mults=complex_mults)
 
 
-def macs_c2r_axis(n: int, direct_max: int = DIRECT_MAX,
-                  complex_mults: int = 4, radix2: bool = False) -> float:
+def macs_c2r_axis(n: int, direct_max: int = DIRECT_MAX, *,
+                  radix2: bool = False, complex_mults: int = 4) -> float:
     """MACs per OUTPUT element for the C2R last pass (``irfft``): direct =
     2 real depth-n_out matmuls with conjugate symmetry folded in
     (``_c2r_np``); beyond direct_max the code Hermitian-extends and runs a
@@ -96,7 +108,8 @@ def macs_c2r_axis(n: int, direct_max: int = DIRECT_MAX,
     n_out = n // 2 + 1
     if n <= direct_max:
         return 2.0 * n_out
-    return macs_c2c_axis(n, direct_max, radix2, complex_mults)
+    return macs_c2c_axis(n, direct_max, radix2=radix2,
+                         complex_mults=complex_mults)
 
 
 # ---------------------------------------------------------------------------
@@ -114,10 +127,12 @@ def mxu_flops_roundtrip_3d(n: int, direct_max: int = DIRECT_MAX,
     (``_rfft_last`` never takes the radix-2 branch)."""
     n_out = n // 2 + 1
     v_half = n * n * n_out
-    macs = (n ** 3 * macs_r2c_axis(n, direct_max, complex_mults)
-            + 4 * v_half * macs_c2c_axis(n, direct_max, radix2,
-                                         complex_mults)
-            + n ** 3 * macs_c2r_axis(n, direct_max, complex_mults, radix2))
+    macs = (n ** 3 * macs_r2c_axis(n, direct_max,
+                                   complex_mults=complex_mults)
+            + 4 * v_half * macs_c2c_axis(n, direct_max, radix2=radix2,
+                                         complex_mults=complex_mults)
+            + n ** 3 * macs_c2r_axis(n, direct_max, radix2=radix2,
+                                     complex_mults=complex_mults))
     return 2.0 * macs
 
 
@@ -129,11 +144,12 @@ def mxu_flops_batched2d(batch: int, m: int, direct_max: int = DIRECT_MAX,
     C2C pass each way on the halved volume, and a C2R pass back."""
     m_out = m // 2 + 1
     v_half = m * m_out
-    macs_plane = (m * m * macs_r2c_axis(m, direct_max, complex_mults)
-                  + 2 * v_half * macs_c2c_axis(m, direct_max, radix2,
-                                               complex_mults)
-                  + m * m * macs_c2r_axis(m, direct_max, complex_mults,
-                                          radix2))
+    macs_plane = (m * m * macs_r2c_axis(m, direct_max,
+                                        complex_mults=complex_mults)
+                  + 2 * v_half * macs_c2c_axis(m, direct_max, radix2=radix2,
+                                               complex_mults=complex_mults)
+                  + m * m * macs_c2r_axis(m, direct_max, radix2=radix2,
+                                          complex_mults=complex_mults))
     return 2.0 * batch * macs_plane
 
 

@@ -170,6 +170,34 @@ class PencilFFTPlan(DistFFTPlan):
                 tuple(pm.even_shard_sizes(self._nz_spec, self._nzc_p2, self.p2)))
         raise ValueError(f"unknown stage {stage!r}")
 
+    def in_sizes(self, axis: str = "x") -> List[int]:
+        """Per-rank logical input extents along a decomposed axis — the
+        pencil rendering of the reference's ``getInSize`` family
+        (``include/mpicufft.hpp:66-79``, inherited by
+        ``mpicufft_pencil.hpp``). Input pencils are decomposed over x (the
+        p1 mesh axis) and y (p2); pad-only shards report 0. Thin projection
+        of ``partition_dims("input")``."""
+        d = self.partition_dims("input")
+        if axis == "x":
+            return list(d.size_x)
+        if axis == "y":
+            return list(d.size_y)
+        raise ValueError("pencil input is decomposed over x and y only, "
+                         f"not {axis!r}")
+
+    def out_sizes(self, axis: str) -> List[int]:
+        """Per-rank logical output extents along a decomposed axis (full-
+        depth dims=3 output: x-pencils, decomposed over y on p1 and the
+        spectral z on p2). Reference ``getOutSize`` family
+        (``include/mpicufft.hpp:66-79``)."""
+        d = self.partition_dims("output")
+        if axis == "y":
+            return list(d.size_y)
+        if axis == "z":
+            return list(d.size_z)
+        raise ValueError("pencil output is decomposed over y and z only, "
+                         f"not {axis!r}")
+
     # -- logical <-> padded helpers ---------------------------------------
 
     def pad_input(self, x):

@@ -108,6 +108,9 @@ def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
     from .. import params as pm
     from ..models.slab import SlabFFTPlan
 
+    if k < 2:
+        raise ValueError(f"overlap_race needs k >= 2 for the (t_K - t_1)"
+                         f"/(K-1) pair difference, got {k}")
     g = pm.GlobalSize(*global_shape)
     scale = 1.0 / float(g.n_total)
     variants = [("sync", None)] + [(f"streams{c}", c) for c in chunk_counts]
@@ -260,13 +263,19 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
     # ceiling cannot drift from the exchange the realigned pipe issues.
     merged_shape = realigned_pack_shape(spec_val.shape,
                                         plan._seq.split_axis, p)
-    merged_val = jax.device_put(
-        jnp.zeros(merged_shape, spec_val.dtype),
-        NamedSharding(mesh, ispec))
     fns = {"opt0": (chained(pipe_pair(False), 1), chained(pipe_pair(False), k)),
            "opt1": (chained(pipe_pair(True), 1), chained(pipe_pair(True), k)),
-           "raw": (chained(pure_pair, 1), chained(pure_pair, k)),
-           "raw_merged": (chained(pure_pair, 1), chained(pure_pair, k))}
+           "raw": (chained(pure_pair, 1), chained(pure_pair, k))}
+    if tuple(merged_shape) != tuple(spec_val.shape):
+        # split_axis == 0 leaves the pack shape unchanged, making this
+        # chain an exact duplicate of "raw" — skip rather than compile and
+        # time the same program twice (ADVICE r4).
+        merged_val = jax.device_put(
+            jnp.zeros(merged_shape, spec_val.dtype),
+            NamedSharding(mesh, ispec))
+        fns["raw_merged"] = (chained(pure_pair, 1), chained(pure_pair, k))
+    else:
+        merged_val = None
     # Chunked-exchange (STREAMS) renderings of the realigned transpose:
     # raced in selection like any variant; a pure-transpose chain has no
     # FFT to overlap with, so this isolates the cost/benefit of splitting
@@ -339,8 +348,8 @@ def transpose_fraction_chain(plan, spec_val, k: int = 8, repeats: int = 5,
     winner = max(by_variant, key=lambda n: by_variant[n]["fraction"])
 
     # PUBLICATION phase: fresh paired repeats of the winner vs the ceiling.
-    pub_fracs, pub_times = run_repeats([winner, "raw", "raw_merged"],
-                                       repeats)
+    pub_fracs, pub_times = run_repeats(
+        [winner] + [n for n in raw_names if n in fns], repeats)
     fs = sorted(pub_fracs[winner])
     if not fs:
         return {"degenerate": True, "k": k, "repeats": repeats,

@@ -97,6 +97,26 @@ def test_partition_dims_tables(devices):
         plan.partition_dims("bogus")
 
 
+def test_pencil_size_table_api(devices):
+    """in_sizes/out_sizes on the pencil plan — the base-class API contract
+    (reference getInSize/getOutSize, include/mpicufft.hpp:66-79) as thin
+    projections of partition_dims. Uneven extents so pad shards report 0."""
+    g = GlobalSize(16, 6, 9)  # ny=6 over p2=4 pads to 8; nz_out=5 -> 8
+    plan = PencilFFTPlan(g, PencilPartition(2, 4), Config())
+    assert plan.in_sizes("x") == [8, 8]
+    assert plan.in_sizes() == [8, 8]  # default axis is x, like slab
+    assert plan.in_sizes("y") == [2, 2, 2, 0]
+    assert plan.out_sizes("y") == [3, 3]
+    assert plan.out_sizes("z") == [2, 2, 1, 0]
+    # Consistency with the underlying stage tables.
+    assert tuple(plan.in_sizes("x")) == plan.partition_dims("input").size_x
+    assert tuple(plan.out_sizes("z")) == plan.partition_dims("output").size_z
+    with pytest.raises(ValueError):
+        plan.in_sizes("z")
+    with pytest.raises(ValueError):
+        plan.out_sizes("x")
+
+
 def test_single_device_fallback(rng):
     g = GlobalSize(12, 12, 12)
     plan = PencilFFTPlan(g, PencilPartition(1, 1))

@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
-from ..parallel.transpose import (all_to_all_transpose, concat_axis_chunks,
+from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
+                                  concat_axis_chunks,
                                   pad_axis_to, slice_axis_to,
                                   split_axis_chunks)
 from .base import _with_pad, jit_stages
@@ -354,10 +355,7 @@ class Batched2DFFTPlan:
             boundary = NamedSharding(mesh, out_spec)
 
             def pure(v):
-                y = stage1(v)
-                pieces = [jax.lax.with_sharding_constraint(p, boundary)
-                          for p in split_axis_chunks(y, 0, k)]
-                return stage2(concat_axis_chunks(pieces, 0))
+                return stage2(chunked_reshard(stage1(v), boundary, 0, k))
 
             return pure, in_spec, out_spec
         return (lambda v: stage2(stage1(v)), in_spec, out_spec)

@@ -52,7 +52,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
-from ..parallel.transpose import (all_to_all_transpose, concat_axis_chunks,
+from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
+                                  concat_axis_chunks,
                                   pad_axis_to, slice_axis_to,
                                   split_axis_chunks)
 from .base import DistFFTPlan, _with_pad
@@ -536,8 +537,11 @@ class PencilFFTPlan(DistFFTPlan):
         PEER2PEER + SYNC: a segment break so GSPMD inserts the resharding
         collective at the boundary.
         PEER2PEER + STREAMS: a chunked break — the boundary reshards K
-        pieces independently (per-piece ``with_sharding_constraint``), so
-        GSPMD emits K smaller collectives it may overlap with neighbours.
+        pieces independently (``chunked_reshard``, shard-aligned pieces
+        since the pencil chunk axes are mesh-sharded). Measured: GSPMD
+        re-fuses the pieces into one collective (see
+        ``SlabFFTPlan._assemble_pure``), so this is equivalent to SYNC;
+        ALL2ALL is the genuinely chunked rendering.
         """
         streams = snd is pm.SendMethod.STREAMS
         if comm is pm.CommMethod.ALL2ALL:
@@ -593,16 +597,22 @@ class PencilFFTPlan(DistFFTPlan):
                 cur_out = spec
             elif isinstance(fn, tuple) and fn[0] == "CHUNKED_BREAK":
                 # PEER2PEER + STREAMS boundary: reshard K pieces of the
-                # global array independently so GSPMD emits K smaller
-                # collectives instead of one monolithic redistribution.
+                # global array independently. Measured (8-device CPU
+                # mesh): GSPMD re-fuses the piece reshards into one
+                # collective — see SlabFFTPlan._assemble_pure — so this
+                # rendering is equivalent to SYNC; the ALL2ALL rendering
+                # is the genuinely chunked pencil path.
                 flush()
                 _, ca, k = fn
                 sh = NamedSharding(mesh, spec)
 
                 def reshard(x, sh=sh, ca=ca, k=k):
-                    return concat_axis_chunks(
-                        [jax.lax.with_sharding_constraint(p, sh)
-                         for p in split_axis_chunks(x, ca, k)], ca)
+                    # The pencil chunk axes are mesh-sharded identically
+                    # on both sides of their boundary (x over p1 at t1, z
+                    # over p2 at t2); chunked_reshard splits within each
+                    # shard's local block so the piece exchanges move
+                    # exactly the monolithic exchange's bytes.
+                    return chunked_reshard(x, sh, ca, k)
 
                 stages.append(reshard)
                 cur_fns = []

@@ -71,6 +71,56 @@ def concat_axis_chunks(pieces, axis: int):
                                                               axis=axis)
 
 
+def chunked_reshard(x, target, axis: int, k: int):
+    """Reshard the global array ``x`` to ``target`` (a NamedSharding) as
+    ``k`` independent piece-reshards along ``axis`` — the PEER2PEER
+    rendering of ``SendMethod.STREAMS``: GSPMD emits one smaller
+    collective per piece instead of one monolithic redistribution,
+    handing its scheduler K independently schedulable exchanges (the TPU
+    counterpart of the reference Streams engine's per-peer sends,
+    ``src/slab/default/mpicufft_slab.cpp:343-448``).
+
+    ``axis`` must be an axis whose sharding the stage boundary does NOT
+    change (the exchange's free axis). When it is unsharded (slab free
+    axis, batched-2D batch axis) the pieces are plain global slices.
+    When it IS mesh-sharded — pencil: x over p1 at transpose 1, z over
+    p2 at transpose 2, identically on both sides — global slices would
+    cross shard boundaries and every piece-reshard would move data along
+    the chunk axis that the monolithic reshard never touches. Instead
+    the axis is reshaped shard-aligned into ``(mesh_extent, local)`` and
+    the pieces split the LOCAL sub-axis, so each piece takes the same
+    local rows of every shard and the K piece exchanges together move
+    exactly the monolithic exchange's bytes."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = list(target.spec) + [None] * (x.ndim - len(target.spec))
+    names = spec[axis]
+    if names is None:
+        pieces = [jax.lax.with_sharding_constraint(p, target)
+                  for p in split_axis_chunks(x, axis, k)]
+        return concat_axis_chunks(pieces, axis)
+    if isinstance(names, str):
+        names = (names,)
+    mesh_ext = 1
+    for n in names:
+        mesh_ext *= target.mesh.shape[n]
+    ext = x.shape[axis]
+    if ext % mesh_ext:
+        raise ValueError(
+            f"chunk axis extent {ext} not divisible by its mesh extent "
+            f"{mesh_ext} (padded distributed extents always are)")
+    rs_shape = x.shape[:axis] + (mesh_ext, ext // mesh_ext) \
+        + x.shape[axis + 1:]
+    rs_spec = PartitionSpec(*(spec[:axis] + [spec[axis], None]
+                              + spec[axis + 1:]))
+    rs_target = NamedSharding(target.mesh, rs_spec)
+    y = jnp.reshape(x, rs_shape)
+    pieces = [jax.lax.with_sharding_constraint(p, rs_target)
+              for p in split_axis_chunks(y, axis + 1, k)]
+    return jnp.reshape(concat_axis_chunks(pieces, axis + 1), x.shape)
+
+
 def realigned_pack_shape(shape, split_axis: int, p: int):
     """Shape the realigned sender pack exchanges (the merged-leading layout
     of ``all_to_all_transpose(..., realigned=True)``'s PURE collective) —

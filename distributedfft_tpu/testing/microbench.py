@@ -148,11 +148,20 @@ def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
 
     times = {name: [] for name, _ in variants}
     for _ in range(repeats):
+        per = {}
         for name in times:
             f1, fK, x = fns[name]
             tK = _time_fn(fK, x, iterations, warmup)
             t1 = _time_fn(f1, x, iterations, warmup)
-            d = (tK - t1) / (k - 1)
+            per[name] = (tK - t1) / (k - 1)
+        # Paired drop anchored on the baseline (the fraction-chain
+        # contract): a repeat whose "sync" sample degenerates is dropped
+        # for EVERY variant, otherwise winner/best_vs_sync would compare
+        # medians over disjoint repeat subsets and a one-repeat host
+        # stall hitting only sync would flip the verdict.
+        if per.get("sync", 0.0) <= 0:
+            continue
+        for name, d in per.items():
             if d > 0:
                 times[name].append(d)
     out = {"shape": list(global_shape), "p": p, "k": k, "repeats": repeats,

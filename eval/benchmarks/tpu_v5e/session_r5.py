@@ -1,0 +1,345 @@
+"""Round-5 single-chip (v5e) measurement session — REORDERED.
+
+Same one-clean-process discipline as ``session_r3.py`` (budget checks
+between cells, immediate fsync'd JSONL appends, on-device input
+generation, no complex device_put), but the cell ORDER is inverted to
+put the BASELINE metric's own sizes first: round 5's first session ran
+the 256^3 canary fine and then hung >30 min inside the 256^3
+inverse-chain compile (degraded-window failure mode — the hang starved
+every cell behind it, including 1024^3). Value-ordered cells mean a
+mid-session hang costs the LEAST important remainder, not the most:
+
+1.  canary — 256^3 roundtrip (cached compile; revalidates the window and
+    the live headline);
+2.  1024^3 forward — the BASELINE metric's own size: chunked four-step
+    (fft3d_chunk=8) vs direct(1024) vs xla, roundtrip for the winner;
+3.  4096^2 x 64 batched-2D chunk sweep (batch_chunk 1/2/4/8);
+4.  opt0-vs-opt1 LOCAL relayout A/B at 256^3 (VERDICT-r4 Weak #2);
+5.  C2R-only inverse rows at 256^3 / 512^3;
+6.  512^3 per-axis stage chains;
+7.  512^3 direct(512) vs four-step(16x32) factorization race.
+
+Run (from the repo root, on the axon tunnel):
+    python eval/benchmarks/tpu_v5e/session_r5.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+T0 = time.monotonic()
+BUDGET_S = float(os.environ.get("DFFT_SESSION_BUDGET_S", "1500"))
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                    ".."))
+sys.path.insert(0, REPO)
+OUT = os.environ.get("DFFT_SESSION_OUT") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "session_r5.jsonl")
+
+
+def emit(rec: dict) -> None:
+    rec = {"t_s": round(time.monotonic() - T0, 1), **rec}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    print(rec, flush=True)
+
+
+def remaining() -> float:
+    return BUDGET_S - (time.monotonic() - T0)
+
+
+def fft_equiv_flops(n: int, axes_log2: float) -> float:
+    """FFT-equivalent flops: 2.5 * N^3 * axes_log2 (BASELINE.md §Derived)."""
+    return 2.5 * n ** 3 * axes_log2
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    smoke = bool(os.environ.get("DFFT_SESSION_SMOKE"))
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    emit({"event": "start", "platform": jax.devices()[0].platform,
+          "budget_s": BUDGET_S, "smoke": smoke, "order": "value-first"})
+    # Budget starts from first device CONTACT (a waiting claim may have
+    # cleared a wedge), not process launch.
+    global T0
+    T0 = time.monotonic()
+
+    from distributedfft_tpu.ops import mxu_fft as mx
+    from distributedfft_tpu.testing import chaintimer as ct
+
+    # Capability probe: complex INTERMEDIATE, fresh compile (no cache yet).
+    try:
+        rp = jax.device_put(np.ones((8, 8), np.float32))
+        float(jax.jit(lambda v: jnp.abs(jnp.sum(
+            lax.complex(v, v) * lax.complex(v, -v))))(rp))
+        emit({"event": "complex_ok"})
+    except Exception as e:  # noqa: BLE001
+        emit({"event": "complex_broken", "error": f"{type(e).__name__}: {e}"})
+        return 0
+
+    try:  # persistent cache AFTER the fresh-compile probe (SKILL.md)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001
+        pass
+
+    state = {"broken": False}
+
+    def measure(label: str, build1, buildK, k: int, flops: "float | None",
+                arg=0, repeats: int = 3, inner: int = 3,
+                min_remaining: float = 60.0, extra: "dict | None" = None,
+                bytes_per_iter: "int | None" = None):
+        if state["broken"]:
+            emit({"label": label, "skipped": "bad session"})
+            return
+        if remaining() < min_remaining:
+            emit({"label": label, "skipped":
+                  f"budget ({remaining():.0f}s left)"})
+            return
+        try:
+            fn1, fnK = build1(), buildK()
+            float(fn1(arg))
+            float(fnK(arg))
+            per_ms, _ = ct.median_pair_diff_ms(fn1, fnK, arg, k,
+                                               repeats, inner)
+            rec = {"label": label, "k": k, "per_iter_ms": round(per_ms, 4),
+                   **(extra or {})}
+            if per_ms > 0:
+                if flops is not None:
+                    rec["gflops"] = round(flops / per_ms / 1e6, 1)
+                if bytes_per_iter is not None:
+                    rec["gb_per_s"] = round(bytes_per_iter / per_ms / 1e6, 1)
+            else:
+                rec["degenerate"] = True
+            emit(rec)
+        except Exception as e:  # noqa: BLE001
+            msg = f"{type(e).__name__}: {e}"
+            emit({"label": label, "error": msg[:500]})
+            if "UNIMPLEMENTED" in msg:
+                state["broken"] = True
+
+    # ---- 1. canary: 256^3 roundtrip (cached compile, headline reval) -----
+    n = 32 if smoke else 256
+    k_canary = 9 if smoke else 257
+    measure(f"{n}^3 roundtrip matmul@high",
+            lambda: ct.directional_chain(1, (n, n, n), "matmul", "roundtrip"),
+            lambda: ct.directional_chain(k_canary, (n, n, n), "matmul",
+                                         "roundtrip"),
+            k_canary, fft_equiv_flops(n, 2 * 3 * math.log2(n)))
+    if state["broken"]:
+        emit({"event": "abort", "reason": "canary hit UNIMPLEMENTED"})
+        return 0
+
+    # ---- 2. 1024^3 — the BASELINE metric's own size ----------------------
+    import distributedfft_tpu as dfft
+
+    n = 64 if smoke else 1024
+    fwd_flops = fft_equiv_flops(n, 3 * math.log2(n))
+
+    def plan_forward_chain(k, fwd):
+        def run(seed):
+            u = jax.random.uniform(jax.random.key(seed), (n, n, n),
+                                   jnp.float32)
+            def body(i, acc):
+                c = fwd(u + acc * 1e-30)
+                return acc + jnp.real(c)[0, 0, 0] / float(n) ** 3
+            return lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
+        return jax.jit(run)
+
+    def chunked_plan(ck):
+        return dfft.SlabFFTPlan(
+            dfft.GlobalSize(n, n, n), dfft.SlabPartition(1),
+            dfft.Config(fft_backend="matmul", fft3d_chunk=ck))
+
+    st1024 = mx.MXUSettings.make(direct_max=n)
+    variants = [
+        (f"{n}^3 forward matmul chunked-fourstep ck=8",
+         lambda k: plan_forward_chain(k, chunked_plan(8).forward_fn())),
+        (f"{n}^3 forward matmul direct({n})",
+         lambda k: ct.directional_chain(k, (n, n, n), "matmul", "forward",
+                                        settings=st1024)),
+        (f"{n}^3 forward xla",
+         lambda k: ct.directional_chain(k, (n, n, n), "xla", "forward")),
+    ]
+    fwd_ok = []
+    for label, build in variants:
+        before_err = state["broken"]
+        measure(label, lambda b=build: b(1), lambda b=build: b(9), 9,
+                fwd_flops, min_remaining=180.0)
+        if not before_err and not state["broken"]:
+            with open(OUT) as f:
+                last = json.loads(f.read().strip().splitlines()[-1])
+            if (last.get("label") == label
+                    and last.get("per_iter_ms", 0) > 0
+                    and not last.get("degenerate")):
+                fwd_ok.append((label, last["per_iter_ms"]))
+
+    if fwd_ok and remaining() > 240:
+        best = min(fwd_ok, key=lambda t: t[1])[0]
+        rt_flops = fft_equiv_flops(n, 2 * 3 * math.log2(n))
+        if "chunked" in best:
+            plan = chunked_plan(8)
+            fwd, inv = plan.forward_fn(), plan.inverse_fn()
+            scale = 1.0 / float(n) ** 3
+
+            def rt_chain(k):
+                def run(seed):
+                    u = jax.random.uniform(jax.random.key(seed), (n, n, n),
+                                           jnp.float32)
+                    def body(i, v):
+                        return inv(fwd(v)) * scale
+                    return jnp.sum(jnp.abs(lax.fori_loop(0, k, body, u)))
+                return jax.jit(run)
+            measure(f"{n}^3 roundtrip matmul chunked-fourstep ck=8",
+                    lambda: rt_chain(1), lambda: rt_chain(5), 5, rt_flops,
+                    min_remaining=180.0)
+        else:
+            st = st1024 if "direct" in best else None
+            be = "xla" if "xla" in best else "matmul"
+            measure(f"{n}^3 roundtrip {be}"
+                    + (" direct(1024)" if st else ""),
+                    lambda: ct.directional_chain(1, (n, n, n), be,
+                                                 "roundtrip", settings=st),
+                    lambda: ct.directional_chain(5, (n, n, n), be,
+                                                 "roundtrip", settings=st),
+                    5, rt_flops, min_remaining=180.0)
+
+    # ---- 3. 4096^2 x 64 batched-2D chunk sweep ---------------------------
+    from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+    from distributedfft_tpu.testing.workloads import flops_batched2d
+
+    b, m = (8, 128) if smoke else (64, 4096)
+    b2d_flops = flops_batched2d(b, m, m)
+    for ck in ((1, 2) if smoke else (1, 2, 4, 8)):
+        plan = Batched2DFFTPlan(b, m, m, dfft.SlabPartition(1),
+                                dfft.Config(fft_backend="matmul"),
+                                batch_chunk=ck)
+        fwd, inv = plan.forward_fn(), plan.inverse_fn()
+        scale = 1.0 / float(m * m)
+
+        def b2d_chain(k, fwd=fwd, inv=inv, scale=scale):
+            def run(seed):
+                u = jax.random.uniform(jax.random.key(seed), (b, m, m),
+                                       jnp.float32)
+                def body(i, v):
+                    return inv(fwd(v)) * scale
+                return jnp.sum(jnp.abs(lax.fori_loop(0, k, body, u)))
+            return jax.jit(run)
+
+        measure(f"{m}^2x{b} batched2d roundtrip matmul ck={ck}",
+                lambda: b2d_chain(1), lambda: b2d_chain(5), 5, b2d_flops,
+                min_remaining=150.0)
+
+    # ---- 4. opt0-vs-opt1 LOCAL relayout A/B (VERDICT-r4 Weak #2) ---------
+    # One chip cannot run the 8-way collective, but the two renderings
+    # differ exactly in WHERE the relayout happens (see session_r3.py cell
+    # 7 for the full rationale); this prices both local relayout patterns
+    # on real v5e HBM against a 2-pass copy floor.
+    n = 32 if smoke else 256
+    p_sim = 8
+    s_ax, c_ax = 1, 0
+
+    def relayout_chain(kk, body_once):
+        def run(seed):
+            u = jax.random.uniform(jax.random.key(seed), (n, n, n),
+                                   jnp.float32)
+            v0 = lax.complex(u, -u)
+            def body(i, v):
+                return body_once(v)
+            return jnp.sum(jnp.abs(lax.fori_loop(0, kk, body, v0)))
+        return jax.jit(run)
+
+    def opt1_pair(v):
+        shp = v.shape
+        m2 = v.reshape(shp[:s_ax] + (p_sim, shp[s_ax] // p_sim)
+                       + shp[s_ax + 1:])
+        m2 = jnp.moveaxis(m2, s_ax, 0)
+        m2 = m2.reshape((m2.shape[0] * m2.shape[1],) + m2.shape[2:])
+        m2 = lax.optimization_barrier(m2)
+        piece = m2.shape[0] // p_sim
+        r = m2.reshape((p_sim, piece) + m2.shape[1:])
+        r = jnp.moveaxis(r, 0, s_ax)
+        out = list(r.shape)
+        merged = out.pop(s_ax)
+        out[s_ax] *= merged
+        return lax.optimization_barrier(r.reshape(tuple(out)))
+
+    def opt0_pair(v):
+        y = jnp.concatenate(jnp.split(v, p_sim, axis=s_ax), axis=c_ax)
+        y = lax.optimization_barrier(y)
+        z = jnp.concatenate(jnp.split(y, p_sim, axis=c_ax), axis=s_ax)
+        return lax.optimization_barrier(z)
+
+    def copy_pair(v):
+        return lax.optimization_barrier(
+            lax.optimization_barrier(v * (1.0 + 1e-7)) * (1.0 - 1e-7))
+
+    nbytes = n * n * n * 8
+    k_ab = 5 if smoke else 33
+    for label, pair in (("opt1_pack_pair", opt1_pair),
+                        ("opt0_scatter_pair", opt0_pair),
+                        ("copy_floor_pair", copy_pair)):
+        measure(f"relayout {label}",
+                lambda pair=pair: relayout_chain(1, pair),
+                lambda pair=pair: relayout_chain(k_ab, pair),
+                k_ab, None, min_remaining=45.0,
+                extra={"p_sim": p_sim, "nbytes": nbytes},
+                bytes_per_iter=2 * 2 * nbytes)
+
+    # ---- 5. C2R-only inverse rows ----------------------------------------
+    for n, k in ((32, 5), (48, 5)) if smoke else ((256, 257), (512, 33)):
+        measure(f"{n}^3 inverse-only matmul@high",
+                lambda n=n: ct.directional_chain(1, (n, n, n), "matmul",
+                                                 "inverse"),
+                lambda n=n, k=k: ct.directional_chain(k, (n, n, n), "matmul",
+                                                      "inverse"),
+                k, fft_equiv_flops(n, 3 * math.log2(n)))
+
+    # ---- 6. 512^3 per-axis stage breakdown -------------------------------
+    n = 32 if smoke else 512
+    for stage in ct.STAGES:
+        measure(f"{n}^3 stage {stage} matmul@high",
+                lambda s=stage: ct.stage_chain(1, (n, n, n), "matmul", s),
+                lambda s=stage: ct.stage_chain(17, (n, n, n), "matmul", s),
+                17, fft_equiv_flops(n, math.log2(n)))
+
+    # ---- 7. 512^3 direct vs four-step factorization ----------------------
+    st4 = mx.MXUSettings.make(direct_max=16 if smoke else 256)
+    measure(f"{n}^3 roundtrip matmul@high four-step"
+            + ("(4x8)" if smoke else "(16x32)"),
+            lambda: ct.directional_chain(1, (n, n, n), "matmul", "roundtrip",
+                                         settings=st4),
+            lambda: ct.directional_chain(33, (n, n, n), "matmul", "roundtrip",
+                                         settings=st4),
+            33, fft_equiv_flops(n, 2 * 3 * math.log2(n)))
+    measure(f"{n}^3 roundtrip matmul@high direct({n})",
+            lambda: ct.directional_chain(1, (n, n, n), "matmul", "roundtrip"),
+            lambda: ct.directional_chain(33, (n, n, n), "matmul",
+                                         "roundtrip"),
+            33, fft_equiv_flops(n, 2 * 3 * math.log2(n)))
+
+    emit({"event": "done", "broken": state["broken"]})
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except Exception as e:  # noqa: BLE001 — always exit cleanly
+        emit({"event": "crash", "error": f"{type(e).__name__}: {e}"[:500]})
+        rc = 0
+    sys.exit(rc)

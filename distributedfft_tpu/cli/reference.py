@@ -134,8 +134,10 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
                   file=sys.stderr)
             return 1
         lo, hi = r["fraction_spread"]
+        rlo, rhi = r.get("fraction_range", (lo, hi))
         print(f"All2All fraction: {r['fraction']:.3f} "
-              f"[{r.get('variant', 'opt0')}, spread {lo:.3f}-{hi:.3f}, "
+              f"[{r.get('variant', 'opt0')}, IQR {lo:.3f}-{hi:.3f}, "
+              f"range {rlo:.3f}-{rhi:.3f}, "
               f"pipeline {r['pipe_gb_per_s']:.3f} GB/s vs ceiling "
               f"{r['raw_gb_per_s']:.3f} GB/s, k={r['k']}, "
               f"{p} devices]")

@@ -313,6 +313,44 @@ def _child_tpu(deadline_s: int) -> int:
                                                else 2)
                 rec["gflops"] = round(flops / per_ms / 1e6, 1)
             out["sizes"][str(n)] = rec
+        # Inverse-direction rows (VERDICT r4 item 5: the reference ships a
+        # separate inverse benchmark tree, eval/benchmarks/argon/inverse/,
+        # and the committed CSV cannot prove this direction for the
+        # artifact's own run). Directional chains generate input on
+        # device; one attempt each — these are supplements, and a failure
+        # must not eat the batched-2D row's deadline share.
+        if not out.get("process_broken") and mode == "roundtrip":
+            for n_inv, k_inv in ((256, 257), (512, 33)):
+                if n_inv not in sizes:
+                    continue
+                try:
+                    fn1 = chaintimer.directional_chain(1, (n_inv,) * 3,
+                                                       backend, "inverse")
+                    fnK = chaintimer.directional_chain(k_inv, (n_inv,) * 3,
+                                                       backend, "inverse")
+                    float(fn1(0))
+                    float(fnK(0))
+                    per_ms, _ = chaintimer.median_pair_diff_ms(
+                        fn1, fnK, 0, k_inv, repeats=3, inner=3)
+                    rec = {"per_iter_ms": round(per_ms, 4), "k": k_inv,
+                           "mode": "inverse"}
+                    if per_ms > 0:
+                        rec["gflops"] = round(
+                            _flops_roundtrip(n_inv) / 2 / per_ms / 1e6, 1)
+                    else:
+                        rec["degenerate"] = True
+                    out["sizes"][f"{n_inv}:inverse"] = rec
+                except TimeoutError:
+                    raise  # the child deadline must reach the partial path
+                except Exception as e:  # noqa: BLE001 — supplement only
+                    out["sizes"][f"{n_inv}:inverse"] = {
+                        "error": f"{type(e).__name__}: {e}"}
+                    if "UNIMPLEMENTED" in str(e):
+                        # Same bad-session semantics as the cube loop: a
+                        # broken process keeps failing; stop burning the
+                        # deadline (gates _tpu_batched2d too).
+                        out["process_broken"] = True
+                        break
         _tpu_batched2d(out, backend)
     except TimeoutError as e:
         out["partial"] = True
@@ -397,6 +435,8 @@ def _tpu_batched2d(out: dict, backend: str) -> None:
 def _child_mesh() -> int:
     """CPU-mesh metrics (tunnel-immune): raw all-to-all GB/s, the slab
     pipeline's achieved fraction of it, and a CPU fallback roundtrip."""
+    t_child0 = time.monotonic()
+
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
@@ -488,6 +528,59 @@ def _child_mesh() -> int:
         out["geometry_gb_per_s"] = geoms
     except Exception as e:  # noqa: BLE001 — optional attribution data
         out["geometry_error"] = f"{type(e).__name__}: {e}"
+
+    # Distributed-pipeline roundtrip per slab sequence (VERDICT r4 item
+    # 5: one non-default-sequence row the artifact measures itself —
+    # Z_Then_YX exchanges the full complex volume where ZY_Then_X
+    # exchanges the halved one, so their ratio is a real diagnostic, not
+    # a duplicate). K-chained forward∘inverse over the mesh; scale folds
+    # the Nx·Ny·Nz roundtrip factor back out so the loop is numerically
+    # stationary. Guarded: diagnostics must not discard the core metrics.
+    # _child_mesh has no internal SIGALRM and prints its JSON only at the
+    # end, so overrunning MESH_TIMEOUT_S loses the already-measured core
+    # gate metrics, not just these supplements: skip the block entirely
+    # unless comfortably inside the parent's cap.
+    if time.monotonic() - t_child0 > 0.6 * MESH_TIMEOUT_S:
+        out["mesh_sequence_error"] = "skipped: mesh child deadline headroom"
+    else:
+        try:
+            import jax.numpy as jnp
+            from jax import lax
+
+            seq_rows = {}
+            scale = 1.0 / float(n) ** 3
+            for seq in ("ZY_Then_X", "Z_Then_YX"):
+                splan = dfft.SlabFFTPlan(
+                    g, dfft.SlabPartition(p),
+                    dfft.Config(comm_method=dfft.CommMethod.ALL2ALL),
+                    sequence=seq)
+                fwd, inv = splan.forward_fn(), splan.inverse_fn()
+                ishard = splan.input_sharding
+
+                def chain(kk, fwd=fwd, inv=inv, ishard=ishard):
+                    def run(v):
+                        w = lax.fori_loop(
+                            0, kk, lambda i, u: inv(fwd(u)) * scale, v)
+                        return jnp.sum(jnp.abs(w))  # scalar fence
+                    return jax.jit(run, in_shardings=ishard)
+
+                xs = jax.device_put(
+                    np.random.default_rng(0)
+                    .random(splan.input_padded_shape)
+                    .astype(np.float32), ishard)
+                f1, f4 = chain(1), chain(4)
+                float(f1(xs))
+                float(f4(xs))
+                per_ms, _ = chaintimer.median_pair_diff_ms(f1, f4, xs, 4,
+                                                           repeats=3,
+                                                           inner=1)
+                rec = {"roundtrip_ms": round(per_ms, 3)}
+                if per_ms <= 0:
+                    rec["degenerate"] = True  # chaintimer contract
+                seq_rows[seq] = rec
+            out["mesh_pipeline_sequences"] = seq_rows
+        except Exception as e:  # noqa: BLE001 — optional diagnostics
+            out["mesh_sequence_error"] = f"{type(e).__name__}: {e}"
 
     # CPU fallback roundtrip (used as the headline only if the TPU path is
     # unreachable; CPU timers are reliable so a short chain suffices).
@@ -815,6 +908,9 @@ def main() -> int:
                 mesh.get("alltoall_fraction_variants")
         if mesh.get("geometry_gb_per_s"):
             result["geometry_gb_per_s"] = mesh["geometry_gb_per_s"]
+        if mesh.get("mesh_pipeline_sequences"):
+            result["mesh_pipeline_sequences"] = \
+                mesh["mesh_pipeline_sequences"]
     if (tpu or {}).get("partial"):
         diags.append(f"tpu partial: {tpu.get('error')}")
     if diags:

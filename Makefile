@@ -1,0 +1,17 @@
+# Repo-level convenience targets. The native C++ layer has its own
+# Makefile under native/ (kept separate so `make -C native` stays the
+# canonical build there, mirroring the reference's split build).
+
+.PHONY: docs test native clean-docs
+
+docs:
+	python tools/gendocs.py
+
+test:
+	python -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C native
+
+clean-docs:
+	rm -rf documentation

@@ -120,10 +120,18 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
         x = plan.pad_input(np.random.default_rng(0).random(g.shape)
                            .astype(dtype))
         spec = plan.forward_stages()[0][1](x)
+        # --streams-chunks N (N > 1: chunks=1 is byte-identical to the
+        # monolithic opt1 chain) adds the chunked-exchange rendering
+        # (opt1sN) to the selection race, so the gate can report whether
+        # splitting the collective beats the monolithic realigned
+        # exchange.
+        sc = getattr(args, "streams_chunks", None)
+        sv = (sc,) if sc and sc > 1 else ()
         try:
             r = mb.transpose_fraction_chain(plan, spec,
                                             repeats=max(it or 1, 3),
-                                            warmup=max(wu, 1))
+                                            warmup=max(wu, 1),
+                                            streams_variants=sv)
         except ValueError as e:  # shape/divisibility precondition
             print(f"fraction gate unavailable for this shape: {e}",
                   file=sys.stderr)

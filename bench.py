@@ -57,11 +57,14 @@ MEASURE_RESERVE_S = 120      # budget step 3 needs after a successful probe
 # diagnostic rather than a silent absence.
 SIZES = (256, 128, 512, 1024)
 # Batched-2D row (BASELINE config #4 family): "batch,m,chunk" measured
-# after the cube sweep; "0" disables. 4096^2 x 64 fails remote compile as
-# ONE program (HTTP 500), so it runs through Batched2DFFTPlan's
-# batch_chunk path; the default chunk can be retuned once the on-chip
-# chunk sweep (session_r3.py part 6) lands.
-BATCHED_DEFAULT = "64,4096,4"
+# after the cube sweep; "0" disables chunking (whole-stack single
+# program). chunk is the lax.map slice SIZE: chunk=1 = per-plane slices,
+# the MOST chunked form — and the fastest per the 2026-07-31 on-chip
+# sweep (session_r5.jsonl: 483.2 ms vs 541.8/610.0/608.8 at ck=2/4/8;
+# finer slices win at this size). The whole-stack chunk=0 program was
+# NOT measured on-chip — its last attempt (2026-07-30) failed remote
+# compile with HTTP 500, so the default stays on the measured winner.
+BATCHED_DEFAULT = "64,4096,1"
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 

@@ -17,8 +17,9 @@ rides the last, mirroring the 3D schema's halved z).
 ``--shard batch`` (default) shards the batch axis — embarrassingly
 parallel, zero collectives; ``--shard x`` runs the slab-style decomposition
 (1D FFT y -> all_to_all transpose -> 1D FFT x) for batches too small to
-fill the mesh. ``--batch-chunk`` caps compiled-program size via sequential
-``lax.map`` chunks (how 4096^2 x 64 fits the remote-compile limits).
+fill the mesh. ``--batch-chunk`` caps peak memory / compiled-program size via
+sequential ``lax.map`` slices of that size (1 = per-plane, the most
+chunked; the on-chip sweep measured 4096^2 x 64 fastest at 1).
 
 Testcases 0-3 are supported (4 is the 3D Laplacian validation — not
 meaningful for a 2D stack).

@@ -173,6 +173,42 @@ _BACKENDS = {
     "matmul-planes": ("high", False),
 }
 
+# Plan suffix on the backend column (e.g. "matmul@high direct(1024)",
+# "matmul@high four-step(16x32)", "matmul@high ck=1"): the execution-plan
+# variant the row was measured under. The MAC model takes the plan as a
+# ``direct_max`` threshold, so every suffix maps to one:
+#   direct(N)        -> direct_max=N (the whole axis is one contraction);
+#   four-step(AxB)   -> direct_max=max(A,B) (forces the four-step branch;
+#                       the factors themselves are <= max(A,B) so they run
+#                       direct, exactly as measured);
+#   ck=N / chunked   -> batch/stage chunking re-orders work without
+#                       changing the MACs issued -> no override.
+_SUFFIX_DIRECT = re.compile(r"direct\((\d+)\)")
+_SUFFIX_FOURSTEP = re.compile(r"four-step\((\d+)x(\d+)\)")
+
+
+def _parse_backend(label: str):
+    """Split a CSV backend label into (base, direct_max override or None).
+    Returns ``None`` for labels whose MACs the model cannot count."""
+    parts = label.split()
+    if not parts or parts[0] not in _BACKENDS:
+        return None
+    base = parts[0]
+    dmax = None
+    for tok in parts[1:]:
+        m = _SUFFIX_DIRECT.fullmatch(tok)
+        if m:
+            dmax = int(m.group(1))
+            continue
+        m = _SUFFIX_FOURSTEP.fullmatch(tok)
+        if m:
+            dmax = max(int(m.group(1)), int(m.group(2)))
+            continue
+        if tok.startswith("ck=") or tok == "chunked":
+            continue
+        return None  # unknown suffix: skip the row rather than miscount
+    return base, dmax
+
 
 def roofline_rows(csv_path: str = _CSV) -> list:
     """Parse the measured CSV and return roofline dicts for every row
@@ -189,19 +225,24 @@ def roofline_rows(csv_path: str = _CSV) -> list:
             backend = parts[idx["backend"]]
             per_ms = float(parts[idx["per_iter_ms"]])
             nominal = float(parts[idx["gflops"]])
-            if backend not in _BACKENDS or "roundtrip" not in transform:
+            parsed = _parse_backend(backend)
+            if parsed is None or "roundtrip" not in transform:
                 continue
-            precision, r2 = _BACKENDS[backend]
+            base, dmax_override = parsed
+            precision, r2 = _BACKENDS[base]
+            dmax = DIRECT_MAX if dmax_override is None else dmax_override
             m_cube = re.fullmatch(r"(\d+)\^3", size)
             m_b2d = re.fullmatch(r"(\d+)\^2x(\d+)", size)
             if m_cube:
                 n = int(m_cube.group(1))
-                f4 = mxu_flops_roundtrip_3d(n, radix2=r2)
-                f3 = mxu_flops_roundtrip_3d(n, radix2=r2, complex_mults=3)
+                f4 = mxu_flops_roundtrip_3d(n, dmax, radix2=r2)
+                f3 = mxu_flops_roundtrip_3d(n, dmax, radix2=r2,
+                                            complex_mults=3)
             elif m_b2d:
                 m, b = int(m_b2d.group(1)), int(m_b2d.group(2))
-                f4 = mxu_flops_batched2d(b, m, radix2=r2)
-                f3 = mxu_flops_batched2d(b, m, complex_mults=3, radix2=r2)
+                f4 = mxu_flops_batched2d(b, m, dmax, radix2=r2)
+                f3 = mxu_flops_batched2d(b, m, dmax, complex_mults=3,
+                                         radix2=r2)
             else:
                 continue
             peak = effective_peak_tflops(precision)
@@ -228,6 +269,20 @@ def _cube512_clause(rows) -> str:
             return (f" (512^3 runs at {100 * r['util_3mm']:.0f}-"
                     f"{100 * r['util_4mm']:.0f}% of effective peak)")
     return ""
+
+
+def _nominal_drop_clause(rows) -> str:
+    """The 256^3 -> 512^3 nominal-GFLOPS drop, quoted FROM the rendered
+    rows for the same can't-contradict-the-table reason; falls back to
+    the sizeless statement when either row is absent."""
+    vals = {}
+    for r in rows:
+        if r["backend"] == "matmul@high" and r["size"] in ("256^3", "512^3"):
+            vals.setdefault(r["size"], r["nominal_gflops"])
+    if len(vals) == 2:
+        return (f"the 256^3 -> 512^3 nominal drop ({vals['256^3']:.1f} -> "
+                f"{vals['512^3']:.1f}) is")
+    return "the nominal fall with size is"
 
 
 def render_markdown(rows, path: Optional[str] = None) -> str:
@@ -266,15 +321,18 @@ def render_markdown(rows, path: Optional[str] = None) -> str:
         "",
         "Reading: NOMINAL GFLOPS (2.5·N·log2 N — what a textbook FFT would",
         "need) falls with size because the matmul backend spends O(n)",
-        "MACs/element per axis, while MXU utilization stays high — the",
-        "256^3 -> 512^3 nominal drop (1357.6 -> 814.9) is the O(n)/O(log n)",
+        "MACs/element per axis, while MXU utilization stays high — "
+        + _nominal_drop_clause(rows) + " the O(n)/O(log n)",
         "flop-count ratio growing, not the chip idling"
         + _cube512_clause(rows) + ". The outliers are the point of the",
         "table: matmul-r2's low utilization shows its interleave relayout",
         "starving the MXU (matching its measured net loss), and the",
-        "2048^2x64 row's ~5% shows the four-step swapaxes relayouts are",
-        "HBM-bound — the chunk sweep (session_r3.py part 6) attacks",
-        "exactly that.",
+        "batched-2D rows' low single digits show the four-step swapaxes",
+        "relayouts are HBM-bound — the 2026-07-31 on-chip chunk sweep",
+        "(session_r5.jsonl) found per-plane lax.map slices (chunk size 1)",
+        "fastest, with larger fused slices monotonically slower (the",
+        "whole-stack fused program failed remote compile 2026-07-30 and",
+        "remains unmeasured).",
     ]
     text = "\n".join(lines) + "\n"
     if path:

@@ -54,13 +54,16 @@ def test_table_from_committed_csv():
     sizes = {r["size"] for r in rows}
     assert {"128^3", "256^3", "512^3", "2048^2x64"} <= sizes
     for r in rows:
-        # 3mm is a strict subset of 4mm work, and neither bound may claim
-        # more than ~10% above peak (the 4mm upper bound on 128^3 sits
-        # just above 100% — that overshoot is the lowering evidence the
-        # table documents, not an error).
+        # 3mm (the cheapest known complex-dot lowering) is the physically
+        # binding bound: it may never exceed peak. 4mm is an over-count by
+        # construction whenever XLA uses the 3-mult form, so it may land
+        # above peak — 128^3 at ~106% and the direct(1024) 1024^3 row at
+        # ~118% are the lowering evidence the table documents — but a 4mm
+        # claim far past 4/3 of peak would mean the MAC model itself is
+        # wrong, not the lowering assumption.
         assert r["util_3mm"] < r["util_4mm"]
         assert 0 < r["util_3mm"] <= 1.0
-        assert r["util_4mm"] < 1.10
+        assert r["util_4mm"] < 4.0 / 3.0
     md = rl.render_markdown(rows)
     assert "512^3" in md and "utilization" in md
 

@@ -16,7 +16,13 @@ healthy, in value order:
    the committed 256^3 row);
 5. 512^3 roundtrip under the xla backend — the backend race at a size
    where the committed table only has matmul rows (xla fails compile at
-   1024^3; 512^3 bounds where the crossover could hide).
+   1024^3; 512^3 bounds where the crossover could hide);
+6. 2048^2 x 64 batched-2D at batch_chunk=1 — the 4096^2 sweep found
+   per-plane slices fastest, so race ck=1 against the committed
+   unchunked 137.8 ms row;
+7. 4096^2 x 64 whole-stack fused (batch_chunk=None) — the one sweep
+   point session_r5 never ran; a clean error record of the 2026-07-30
+   remote-compile HTTP 500 is as valuable as a number.
 
 Same one-clean-process discipline as ``session_r5.py``: budget checks
 between cells, fsync'd JSONL appends, on-device input generation, no
@@ -181,6 +187,51 @@ def main() -> int:
             lambda: ct.directional_chain(1, (n, n, n), "xla", "roundtrip"),
             lambda: ct.directional_chain(17, (n, n, n), "xla", "roundtrip"),
             17, fft_equiv_flops(n, 2 * 3 * math.log2(n)), min_remaining=90.0)
+
+    # ---- 6. per-plane chunking at 2048^2 x 64 ---------------------------
+    # The 4096^2 sweep found the finest lax.map slices fastest; the
+    # committed 2048^2 x 64 row (137.8 ms) was measured UNchunked — race
+    # ck=1 against it.
+    from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+    from distributedfft_tpu.testing.workloads import flops_batched2d
+    import distributedfft_tpu as dfft
+
+    # Same jitted body and timing as workloads.batched2d_chain (which
+    # produced the committed 137.8 ms row) but with the input generated
+    # ON DEVICE from the seed — this session's tunnel defense (a 1-4 GB
+    # host transfer has no place inside a measurement window); input
+    # staging is outside the timed chain either way.
+    def b2d_chain(k, ck, b, m):
+        plan = Batched2DFFTPlan(b, m, m, dfft.SlabPartition(1),
+                                dfft.Config(fft_backend="matmul"),
+                                batch_chunk=ck)
+        fwd, inv = plan.forward_fn(), plan.inverse_fn()
+        scale = 1.0 / float(m * m)
+
+        def run(seed):
+            u = jax.random.uniform(jax.random.key(seed), (b, m, m),
+                                   jnp.float32)
+            def body(i, v):
+                return inv(fwd(v)) * scale
+            return jnp.sum(jnp.abs(lax.fori_loop(0, k, body, u)))
+        return jax.jit(run)
+
+    b, m = (8, 64) if smoke else (64, 2048)
+    k_b = 5 if smoke else 9
+    measure(f"{m}^2x{b} batched2d roundtrip matmul ck=1",
+            lambda: b2d_chain(1, 1, b, m),
+            lambda: b2d_chain(k_b, 1, b, m), k_b,
+            flops_batched2d(b, m, m), min_remaining=90.0)
+
+    # ---- 7. whole-stack fused 4096^2 x 64 (retest the 2026-07-30 500) ---
+    # batch_chunk=None is the one sweep point session_r5 never ran; its
+    # last attempt failed remote compile. A clean error record is as
+    # valuable as a number here.
+    if not smoke:
+        measure("4096^2x64 batched2d roundtrip matmul unchunked",
+                lambda: b2d_chain(1, None, 64, 4096),
+                lambda: b2d_chain(3, None, 64, 4096), 3,
+                flops_batched2d(64, 4096, 4096), min_remaining=75.0)
 
     emit({"event": "done", "broken": state["broken"]})
     return 0

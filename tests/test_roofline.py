@@ -76,3 +76,34 @@ def test_committed_markdown_is_current():
     with open(md_path) as f:
         committed = f.read()
     assert committed == rl.render_markdown(rl.roofline_rows(CSV))
+
+
+def test_parse_backend_plan_suffixes():
+    """The plan-suffix grammar of the CSV backend column: base label plus
+    direct(N) / four-step(AxB) / ck=N tokens; anything else skips the row
+    (None) rather than miscounting it."""
+    assert rl._parse_backend("matmul@high") == ("matmul@high", None)
+    assert rl._parse_backend("matmul@high direct(1024)") == ("matmul@high",
+                                                             1024)
+    assert rl._parse_backend("matmul@high four-step(16x32)") == (
+        "matmul@high", 32)
+    assert rl._parse_backend("matmul@high ck=1") == ("matmul@high", None)
+    assert rl._parse_backend("") is None              # empty cell: skip
+    assert rl._parse_backend("xla") is None           # no MXU count
+    assert rl._parse_backend("matmul@high mystery") is None  # unknown suffix
+
+
+def test_fourstep_suffix_macs_match_measured_plan():
+    """four-step(16x32) -> direct_max=32 must reproduce the MACs of the
+    session's actual plan (direct_max=256): _split(512) = (16, 32) and
+    both factors run direct under either threshold."""
+    assert rl.mxu_flops_roundtrip_3d(512, 32) == rl.mxu_flops_roundtrip_3d(
+        512, 256)
+
+
+def test_metric_size_rows_in_roofline():
+    """The BASELINE metric's own size must appear in the rendered table —
+    the plan-suffix parsing exists so the 1024^3 row is not dropped."""
+    rows = rl.roofline_rows(CSV)
+    assert any(r["size"] == "1024^3" for r in rows)
+    assert any(r["size"] == "4096^2x64" for r in rows)

@@ -165,6 +165,22 @@ def main() -> int:
                                                settings=st1024),
                 5, fft_equiv_flops(n, math.log2(n)), min_remaining=120.0)
 
+    # ---- 3b. 1024^3 forward radix-2(direct-512) — crossover probe -------
+    # At 256^3 one radix-2 level LOST (relayout > halved MXU depth,
+    # committed negative result). At 1024 the depth saving per element
+    # doubles while the relayout cost stays flat, so the crossover may
+    # flip: radix2 with direct_max=512 does exactly ONE split level
+    # (macs: 4*512 vs direct's 4*1024 per element on the C2C axes).
+    # An OOM/compile error here is an acceptable clean record.
+    st_r2 = mx.MXUSettings.make(direct_max=512 if not smoke else 32,
+                                radix2=True)
+    measure(f"{n}^3 forward matmul-r2 direct({512 if not smoke else 32})",
+            lambda: ct.directional_chain(1, (n, n, n), "matmul", "forward",
+                                         settings=st_r2),
+            lambda: ct.directional_chain(5, (n, n, n), "matmul", "forward",
+                                         settings=st_r2),
+            5, fft_equiv_flops(n, 3 * math.log2(n)), min_remaining=150.0)
+
     # ---- 4. 512^3 Poisson solve chain (BASELINE config #5 family) -------
     from distributedfft_tpu.testing.workloads import (flops_poisson,
                                                       poisson_chain)

@@ -93,3 +93,36 @@ def test_child_json_contract():
             # the cube sweep's record survives it.
             assert "batched2d_error" in parsed, parsed
             assert "16" in parsed.get("sizes", {}), parsed
+
+
+def test_committed_measurement_metric_rows_and_robustness(tmp_path,
+                                                          monkeypatch):
+    """_committed_tpu_measurement surfaces the 1024^3 metric-size rows
+    alongside the 256^3 headline, and one malformed CSV row must not
+    nullify the rest (code-review r5)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    # Real committed artifact: headline + both metric-size rows present.
+    m = bench._committed_tpu_measurement()
+    assert m is not None and m["vs_baseline"] > 0
+    ms = m["metric_size_1024"]
+    assert {"forward", "roundtrip"} <= set(ms)
+    assert ms["forward"]["gflops_per_chip"] > 0
+
+    # Synthetic artifact with a malformed row BEFORE the good ones.
+    fake = tmp_path / "eval" / "benchmarks" / "tpu_v5e"
+    fake.mkdir(parents=True)
+    (fake / "single_chip_chain_timed.csv").write_text(
+        "size,transform,backend,per_iter_ms,gflops,chain_k,measured\n"
+        "1024^3,R2C+C2R roundtrip f32,matmul@high,n/a,n/a,5,bad row\n"
+        "256^3,R2C+C2R roundtrip f32,matmul@high,1.5,1340.0,257,src\n"
+        "1024^3,forward R2C only f32,matmul@high direct(1024),123.4,652.4,"
+        "9,src\n")
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    m = bench._committed_tpu_measurement()
+    assert m is not None and m["per_iter_ms"] == 1.5
+    assert m["metric_size_1024"]["forward"]["gflops_per_chip"] == 652.4
+    assert "roundtrip" not in m["metric_size_1024"]  # the bad row skipped

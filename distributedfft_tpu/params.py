@@ -290,15 +290,21 @@ class Config:
     None (default) = fused, no chunking. R2C/C2R only; ignored by
     distributed plans (shard the cube instead).
 
-    ``mxu_precision`` / ``mxu_karatsuba`` / ``mxu_fourstep_einsum`` are the
-    matmul-family backend knobs as PLAN state (read at trace time through a
-    context-scoped ``mxu_fft.MXUSettings``, so two plans with different
-    settings coexist in one process). Each knob is tri-state: None defers
-    PER KNOB to the deprecated ``mxu_fft.set_*`` process defaults;
-    an explicit value wins. ``mxu_precision`` is the single-precision
-    DFT-matmul MXU precision: "default" (raw bf16), "high" (the measured
-    accuracy/speed sweet spot on v5e — also the process default), or
-    "highest"; f64 always runs HIGHEST.
+    ``mxu_precision`` / ``mxu_karatsuba`` / ``mxu_fourstep_einsum`` /
+    ``mxu_direct_max`` are the matmul-family backend knobs as PLAN state
+    (read at trace time through a context-scoped ``mxu_fft.MXUSettings``,
+    so two plans with different settings coexist in one process). Each
+    knob is tri-state: None defers PER KNOB to the deprecated
+    ``mxu_fft.set_*`` process defaults; an explicit value wins.
+    ``mxu_precision`` is the single-precision DFT-matmul MXU precision:
+    "default" (raw bf16), "high" (the measured accuracy/speed sweet spot
+    on v5e — also the process default), or "highest"; f64 always runs
+    HIGHEST. ``mxu_direct_max`` is the direct-plan threshold: axes up to
+    this length are one dense contraction, longer axes take the
+    four-step factorization — on a v5e at 1024^3 the all-direct plan
+    (``mxu_direct_max=1024``) beat the default four-step 2.9x
+    (session_r5.jsonl 2026-07-31; ``autotune_local_fft`` races it
+    automatically past the default threshold).
     """
 
     comm_method: CommMethod = CommMethod.ALL2ALL
@@ -316,6 +322,7 @@ class Config:
     mxu_precision: Optional[str] = None
     mxu_karatsuba: Optional[bool] = None
     mxu_fourstep_einsum: Optional[bool] = None
+    mxu_direct_max: Optional[int] = None
     fft3d_chunk: Optional[int] = None
     streams_chunks: Optional[int] = None
 
@@ -332,6 +339,12 @@ class Config:
             raise ValueError(
                 f"fft3d_chunk must be a positive int or None, "
                 f"got {self.fft3d_chunk!r}")
+        if self.mxu_direct_max is not None and (
+                not isinstance(self.mxu_direct_max, int)
+                or self.mxu_direct_max < 1):
+            raise ValueError(
+                f"mxu_direct_max must be a positive int or None, "
+                f"got {self.mxu_direct_max!r}")
         if self.streams_chunks is not None and (
                 not isinstance(self.streams_chunks, int)
                 or self.streams_chunks < 1):
@@ -350,7 +363,8 @@ class Config:
         defaults in effect at build time (a later ``set_*`` call does not
         reach an already-built plan)."""
         if (self.mxu_precision is None and self.mxu_karatsuba is None
-                and self.mxu_fourstep_einsum is None):
+                and self.mxu_fourstep_einsum is None
+                and self.mxu_direct_max is None):
             return None
         import dataclasses as dc
 
@@ -366,6 +380,8 @@ class Config:
             kw["karatsuba"] = self.mxu_karatsuba
         if self.mxu_fourstep_einsum is not None:
             kw["fourstep_einsum"] = self.mxu_fourstep_einsum
+        if self.mxu_direct_max is not None:
+            kw["direct_max"] = self.mxu_direct_max
         return dc.replace(base, **kw)
 
     def resolved_comm2(self) -> CommMethod:

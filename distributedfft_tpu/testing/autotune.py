@@ -38,6 +38,7 @@ from . import chaintimer
 class Candidate:
     backend: str
     precision: Optional[str]  # matmul-only: "high" | "highest"
+    direct_max: Optional[int] = None  # matmul-only: direct-plan threshold
     per_iter_ms: float = float("nan")
     rel_err: float = float("nan")
     ok: bool = False
@@ -45,8 +46,11 @@ class Candidate:
 
     @property
     def label(self) -> str:
-        return self.backend if self.precision is None \
+        base = self.backend if self.precision is None \
             else f"{self.backend}@{self.precision}"
+        if self.direct_max is not None:
+            base += f" direct({self.direct_max})"
+        return base
 
 
 def _measure(shape, backend: str, k: int, repeats: int, inner: int,
@@ -109,9 +113,19 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
     x = jax.device_put(xs)
 
     cands: List[Candidate] = []
+    n_max = int(max(shape))
     for b in backends:
         if b in ("matmul", "matmul-r2") and not double_prec:
             cands += [Candidate(b, "high"), Candidate(b, "highest")]
+            # Past the deployed direct threshold the default plan is the
+            # four-step factorization; race the all-direct plan too — on
+            # v5e at 1024^3 direct beat the four-step 2.9x (652 vs 228
+            # GFLOPS, session_r5.jsonl 2026-07-31), a winner no
+            # precision-only race can find. (matmul only: radix-2's
+            # direct_max interacts with its split base.)
+            if b == "matmul" and n_max > mxu_fft.current_settings(
+                    ).direct_max:
+                cands.append(Candidate(b, "high", direct_max=n_max))
         else:
             cands.append(Candidate(b, None))
 
@@ -120,17 +134,21 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
                          "difference needs at least one extra iteration")
     import dataclasses as dc
     for c in cands:
-        # Matmul variants race at their own precision via an explicit
-        # MXUSettings (context-scoped, so nothing leaks between candidates
-        # or into the process defaults). The base is the DEPLOYED defaults
-        # — only the precision knob varies — so the measurement predicts
-        # the configuration apply_best's Config resolves to at build time
-        # (its non-precision knobs fall back to the same defaults).
-        # Candidates without a precision (xla, pallas, f64 matmul) race at
-        # the deployed defaults unchanged.
-        st = (dc.replace(mxu_fft.current_settings(),
-                         precision=mxu_fft.as_precision(c.precision))
-              if c.precision is not None else None)
+        # Matmul variants race at their own precision (and, for the
+        # all-direct candidate, direct_max) via an explicit MXUSettings
+        # (context-scoped, so nothing leaks between candidates or into
+        # the process defaults). The base is the DEPLOYED defaults — only
+        # the raced knobs vary — so the measurement predicts the
+        # configuration apply_best's Config resolves to at build time
+        # (the unraced knobs fall back to the same defaults). Candidates
+        # without a precision (xla, pallas, f64 matmul) race at the
+        # deployed defaults unchanged.
+        st = None
+        if c.precision is not None:
+            st = dc.replace(mxu_fft.current_settings(),
+                            precision=mxu_fft.as_precision(c.precision))
+            if c.direct_max is not None:
+                st = dc.replace(st, direct_max=c.direct_max)
         try:
             c.per_iter_ms, c.rel_err, c.error = _measure(
                 shape, c.backend, k, repeats, inner, x, x_absmax,
@@ -310,14 +328,15 @@ def apply_best_comm(candidates: List[CommCandidate], base_config=None):
 
 def apply_best(candidates: List[Candidate]):
     """Translate the winning candidate into a ``Config``: the backend plus,
-    for matmul variants, the raced precision as PLAN state
-    (``Config.mxu_precision`` — no process globals are touched, so other
-    plans in the process are unaffected). Raises when no candidate
-    passed."""
+    for matmul variants, the raced precision and direct-plan threshold as
+    PLAN state (``Config.mxu_precision`` / ``Config.mxu_direct_max`` — no
+    process globals are touched, so other plans in the process are
+    unaffected). Raises when no candidate passed."""
     from ..params import Config
 
     best = candidates[0]
     if not best.ok:
         raise RuntimeError(
             f"autotune: no usable backend; {describe_failures(candidates)}")
-    return Config(fft_backend=best.backend, mxu_precision=best.precision)
+    return Config(fft_backend=best.backend, mxu_precision=best.precision,
+                  mxu_direct_max=best.direct_max)

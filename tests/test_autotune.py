@@ -127,3 +127,31 @@ class TestCommAutotune:
                                   error="RuntimeError: boom")]
         with pytest.raises(RuntimeError, match="no strategy ran"):
             at.apply_best_comm(cands)
+
+
+def test_direct_plan_raced_past_threshold():
+    """Past the deployed direct_max the matmul candidate list gains an
+    all-direct variant — the plan that won 1024^3 on v5e 2.9x must be
+    discoverable by measurement, not folklore. Raced at a tiny size under
+    a lowered threshold so the CPU race stays fast."""
+    import dataclasses as dc
+    small = dc.replace(mxu_fft.default_settings(), direct_max=8)
+    with mxu_fft.use_settings(small):
+        ranked = at.autotune_local_fft((16, 16, 16), k=17, repeats=1,
+                                       inner=1, backends=("matmul",))
+    labels = {c.label for c in ranked}
+    assert "matmul@high direct(16)" in labels, labels
+    direct = next(c for c in ranked if c.direct_max == 16)
+    assert direct.error is None and np.isfinite(direct.per_iter_ms)
+    # apply_best carries the threshold as plan state when direct wins.
+    cfg = at.apply_best(ranked)
+    assert cfg.mxu_direct_max == ranked[0].direct_max
+    st = cfg.mxu_settings()
+    if ranked[0].direct_max is not None:
+        assert st is not None and st.direct_max == 16
+
+
+def test_direct_variant_absent_below_threshold(ranked):
+    """At sizes the deployed settings already run direct, no redundant
+    direct candidate is raced."""
+    assert all(c.direct_max is None for c in ranked)

@@ -84,3 +84,21 @@ class TestPartitions:
         assert pm.PencilPartition(2, 4).num_ranks == 8
         with pytest.raises(ValueError):
             pm.PencilPartition(2, 0)
+
+
+def test_mxu_direct_max_knob():
+    """mxu_direct_max validates like the other count knobs and reaches the
+    plan's MXUSettings; None leaves settings resolution untouched."""
+    import pytest
+
+    from distributedfft_tpu.params import Config
+
+    with pytest.raises(ValueError):
+        Config(mxu_direct_max=0)
+    with pytest.raises(ValueError):
+        Config(mxu_direct_max=-8)
+    with pytest.raises(ValueError):
+        Config(mxu_direct_max=2.5)
+    assert Config().mxu_settings() is None  # all-None stays deferred
+    st = Config(mxu_direct_max=1024).mxu_settings()
+    assert st is not None and st.direct_max == 1024

@@ -203,6 +203,7 @@ def _child_tpu(deadline_s: int) -> int:
             last_err = None
             size_mode = mode
             fallback_reason = None
+            plan_note = None
             attempts_left = 2
             while attempts_left > 0:
                 attempts_left -= 1
@@ -238,11 +239,12 @@ def _child_tpu(deadline_s: int) -> int:
                         # deadline). Generation (and, for "inverse", the
                         # one spectral-input-building forward) runs once
                         # per call and cancels in the pair difference.
+                        st, plan_note = _direct_plan_override(backend, n)
                         x = 0  # rng seed
-                        fn1 = chaintimer.directional_chain(1, shape,
-                                                           backend, size_mode)
-                        fnK = chaintimer.directional_chain(k, shape,
-                                                           backend, size_mode)
+                        fn1 = chaintimer.directional_chain(
+                            1, shape, backend, size_mode, settings=st)
+                        fnK = chaintimer.directional_chain(
+                            k, shape, backend, size_mode, settings=st)
                     float(fn1(x))  # compile + warm (scalar readback fences)
                     float(fnK(x))
                     per_ms, t1 = chaintimer.median_pair_diff_ms(
@@ -262,7 +264,11 @@ def _child_tpu(deadline_s: int) -> int:
                         # budget (the fallback must not inherit a spent
                         # one); other sizes stop retrying immediately.
                         if size_mode == "roundtrip" and n >= 1024:
-                            # Roundtrip does not fit HBM (MEMORY_1024.md).
+                            # The direct-plan roundtrip fits 16 GB
+                            # (measured 2026-07-31); reaching here means
+                            # this window OOMed it anyway (or a non-matmul
+                            # backend ran the four-step whose temporaries
+                            # do not fit — MEMORY_1024.md). Step down.
                             size_mode = "forward"
                             fallback_reason = "roundtrip did not fit HBM"
                             attempts_left = max(attempts_left, 2)
@@ -303,6 +309,8 @@ def _child_tpu(deadline_s: int) -> int:
                     break
                 continue
             rec = {"per_iter_ms": round(per_ms, 4), "k": k}
+            if plan_note and size_mode != "forward-chunked":
+                rec["plan"] = plan_note
             if size_mode != "roundtrip":
                 rec["mode"] = size_mode
                 if size_mode != mode and fallback_reason:
@@ -635,6 +643,27 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
     signal.alarm(0)
     print(json.dumps(out))
     return 0
+
+
+def _direct_plan_override(backend: str, n: int):
+    """(MXUSettings, artifact note) for sizes where the ALL-DIRECT matmul
+    plan is the measured winner; (None, None) otherwise.
+
+    Evidence-gated: only 1024 has an on-chip race (2026-07-31 session —
+    direct 652 vs chunked four-step 228 GFLOPS, and the direct roundtrip
+    FITS 16 GB at 284.96 ms where the four-step's temporaries do not).
+    Other above-threshold sizes keep the deployed default plan rather
+    than extrapolating the 1024^3 result. The override inherits the
+    DEPLOYED settings (autotune.py pattern) so only direct_max varies."""
+    if backend != "matmul" or n != 1024:
+        return None, None
+    import dataclasses as dc
+
+    from distributedfft_tpu.ops import mxu_fft
+    if n <= mxu_fft.current_settings().direct_max:
+        return None, None  # already direct under the deployed settings
+    return (dc.replace(mxu_fft.current_settings(), direct_max=n),
+            f"direct({n})")
 
 
 def _committed_tpu_measurement():

@@ -126,3 +126,27 @@ def test_committed_measurement_metric_rows_and_robustness(tmp_path,
     assert m is not None and m["per_iter_ms"] == 1.5
     assert m["metric_size_1024"]["forward"]["gflops_per_chip"] == 652.4
     assert "roundtrip" not in m["metric_size_1024"]  # the bad row skipped
+
+
+def test_direct_plan_override_is_evidence_gated():
+    """The all-direct bench override applies exactly where it was measured
+    (matmul at 1024), inherits deployed settings, and stays off elsewhere
+    (code-review r5: no extrapolation to unmeasured sizes)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    from distributedfft_tpu.ops import mxu_fft
+
+    st, note = bench._direct_plan_override("matmul", 1024)
+    assert note == "direct(1024)" and st.direct_max == 1024
+    # Every other knob inherits the deployed settings.
+    cur = mxu_fft.current_settings()
+    assert (st.precision, st.karatsuba, st.fourstep_einsum) == (
+        cur.precision, cur.karatsuba, cur.fourstep_einsum)
+    for backend, n in [("matmul", 512), ("matmul", 2048),
+                       ("matmul-planes", 1024), ("matmul-r2", 1024),
+                       ("xla", 1024)]:
+        assert bench._direct_plan_override(backend, n) == (None, None), (
+            backend, n)

@@ -2,13 +2,29 @@
 # Makefile under native/ (kept separate so `make -C native` stays the
 # canonical build there, mirroring the reference's split build).
 
-.PHONY: docs test native clean-docs
+.PHONY: docs test t1 lint native clean-docs
 
 docs:
 	python tools/gendocs.py
 
 test:
 	python -m pytest tests/ -x -q
+
+# The ROADMAP tier-1 gate, runnable locally: CPU backend, no slow tests,
+# collection errors reported but not fatal (so one broken module cannot
+# hide the rest of the suite's state).
+t1:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider
+
+# Cheap static gate: bytecode-compile everything, then pyflakes when the
+# environment has it (the bench/CI image may not; compileall alone still
+# catches syntax errors in every module).
+lint:
+	python -m compileall -q distributedfft_tpu
+	@python -c "import pyflakes" 2>/dev/null \
+	  && python -m pyflakes distributedfft_tpu \
+	  || echo "pyflakes not installed; compileall-only lint"
 
 native:
 	$(MAKE) -C native

@@ -8,7 +8,20 @@ reference's plan/execute API shape, testcase semantics, benchmark timer and
 evaluation tooling.
 """
 
+# jax version shim: the framework (and its tests) call ``jax.shard_map``,
+# which jax only exports at top level from 0.5; on older runtimes alias the
+# experimental implementation so every call site keeps working. Installed
+# here because importing ANY package submodule runs this first.
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):  # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _jax.shard_map = _shard_map
+del _jax
+
 from .params import (
+    AUTO,
     CommMethod,
     Config,
     FFTNorm,
@@ -21,6 +34,7 @@ from .params import (
     block_sizes,
     block_starts,
     padded_extent,
+    parse_comm_method,
 )
 from .parallel.mesh import (
     PENCIL_AXES,
@@ -41,9 +55,9 @@ from .models.slab import SlabFFTPlan
 from .solvers.poisson import PoissonSolver
 
 __all__ = [
-    "CommMethod", "Config", "FFTNorm", "GlobalSize", "PartitionDims",
+    "AUTO", "CommMethod", "Config", "FFTNorm", "GlobalSize", "PartitionDims",
     "PencilPartition", "SendMethod", "SlabPartition", "SlabSequence",
-    "block_sizes", "block_starts", "padded_extent",
+    "block_sizes", "block_starts", "padded_extent", "parse_comm_method",
     "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
     "make_slab_mesh", "Batched2DFFTPlan", "DistFFTPlan", "PencilFFTPlan",
     "PoissonSolver", "SlabFFTPlan",

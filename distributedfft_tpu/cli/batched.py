@@ -31,7 +31,7 @@ import argparse
 import sys
 
 from .common import (add_common_args, maybe_autotune_comm, run_testcase,
-                     setup_backend)
+                     setup_backend, wisdom_config_kwargs)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -45,7 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--batch-chunk", type=int, default=None,
                     help="transform the per-device batch in sequential "
                          "chunks of this size (lax.map) — caps compiled "
-                         "program size; must divide the local padded batch")
+                         "program size; must divide the local padded batch "
+                         "(0 = whole stack fused, same as omitting the flag)")
     ap.add_argument("--partitions", "-p", type=int, default=0,
                     help="mesh width (default: all devices)")
     ap.add_argument("--c2c", action="store_true",
@@ -67,12 +68,13 @@ def main(argv=None) -> int:
         return 2
     p = args.partitions or len(jax.devices())
     cfg = pm.Config(
-        comm_method=pm.CommMethod.parse(args.comm_method),
+        comm_method=pm.parse_comm_method(args.comm_method),
         send_method=pm.SendMethod.parse(args.send_method),
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
-        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks)
+        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks,
+        **wisdom_config_kwargs(args))
     if getattr(args, "autotune_comm", False):
         if args.shard != "x":
             print("autotune-comm: shard='batch' issues no collectives; "
@@ -81,7 +83,10 @@ def main(argv=None) -> int:
             g = pm.GlobalSize(args.input_dim_z, args.input_dim_x,
                               args.input_dim_y)  # (batch, nx, ny) slots
             cfg = maybe_autotune_comm(args, "batched2d", g,
-                                      pm.SlabPartition(p), cfg, dims=2)
+                                      pm.SlabPartition(p), cfg, dims=2,
+                                      variant="x",
+                                      transform="c2c" if args.c2c
+                                      else "r2c")
     plan = Batched2DFFTPlan(
         batch=args.input_dim_z, nx=args.input_dim_x, ny=args.input_dim_y,
         partition=pm.SlabPartition(p), config=cfg, shard=args.shard,

@@ -46,11 +46,24 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                          "no native f64)")
     ap.add_argument("--benchmark_dir", "-b", default="benchmarks",
                     help="prefix for the benchmark directory")
-    ap.add_argument("--fft-backend", default="xla", choices=BACKENDS,
+    ap.add_argument("--fft-backend", default="xla",
+                    choices=BACKENDS + ("auto",),
                     help="local transform implementation: XLA's FFT "
                          "expansion (default), MXU four-step DFT matmuls "
-                         "(ops/mxu_fft.py), or Pallas fused DFT+twiddle "
-                         "kernels (ops/pallas_fft.py)")
+                         "(ops/mxu_fft.py), Pallas fused DFT+twiddle "
+                         "kernels (ops/pallas_fft.py), or 'auto' — pick by "
+                         "measurement via the wisdom store (race once, "
+                         "reuse on every later run; see --wisdom)")
+    ap.add_argument("--wisdom", default=None, metavar="PATH",
+                    help="persistent plan-wisdom store (JSON; default "
+                         "$DFFT_WISDOM, unset = no store): 'auto' choices "
+                         "and --autotune[-comm] winners are recorded there "
+                         "and reused silently on later runs — the FFTW-"
+                         "wisdom analog of the reference's plan-time tuning")
+    ap.add_argument("--no-wisdom", action="store_true",
+                    help="never consult or write the wisdom store ('auto' "
+                         "then re-races each run; with concrete backends "
+                         "this is byte-identical to not having wisdom)")
     ap.add_argument("--emulate-devices", type=int,
                     default=int(os.environ.get("DFFT_EMULATE_DEVICES", "0")),
                     help="force N virtual CPU devices (0 = use real backend)")
@@ -78,8 +91,10 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                              "scale)")
     if pencil:
         ap.add_argument("--comm-method1", "-comm1", default="Peer2Peer",
-                        help='"Peer2Peer" (XLA-scheduled redistribution) or '
-                             '"All2All" (explicit collective), transpose 1')
+                        help='"Peer2Peer" (XLA-scheduled redistribution), '
+                             '"All2All" (explicit collective) or "auto" '
+                             '(measured via the wisdom store; owns the whole '
+                             'comm x send x opt x chunks choice), transpose 1')
         ap.add_argument("--send-method1", "-snd1", default="Sync",
                         help="Sync (monolithic exchange) | Streams (chunked/"
                              "pipelined transpose, see --streams-chunks) | "
@@ -88,7 +103,10 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                         help="same as --comm-method1 for transpose 2")
         ap.add_argument("--send-method2", "-snd2", default=None)
     else:
-        ap.add_argument("--comm-method", "-comm", default="Peer2Peer")
+        ap.add_argument("--comm-method", "-comm", default="Peer2Peer",
+                        help='"Peer2Peer", "All2All" or "auto" (measured '
+                             "via the wisdom store; owns the whole comm x "
+                             "send x opt x chunks choice)")
         ap.add_argument("--send-method", "-snd", default="Sync",
                         help="Sync (monolithic exchange) | Streams (chunked/"
                              "pipelined transpose, see --streams-chunks) | "
@@ -107,12 +125,24 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                          "cannot reach")
 
 
+def wisdom_config_kwargs(args) -> dict:
+    """Config kwargs carrying the CLI wisdom surface (--wisdom/--no-wisdom,
+    shared by all four executables). Defaults reproduce pre-wisdom behavior
+    exactly: no flag + no $DFFT_WISDOM = no store is ever touched."""
+    return {"wisdom_path": getattr(args, "wisdom", None),
+            "use_wisdom": not getattr(args, "no_wisdom", False)}
+
+
 def maybe_autotune_comm(args, kind, global_size, partition, cfg,
-                        sequence=None, dims=3):
+                        sequence=None, dims=3, variant=None,
+                        transform="r2c"):
     """--autotune-comm: race the comm matrix for this shape on the active
     mesh, print the measured table, and return the winning Config (the
     original one when the flag is off). ``dims`` is the pencil partial
-    depth, so the race times the program the run will actually execute."""
+    depth and ``transform`` the r2c/c2c choice, so the race times the
+    program the run will actually execute. The winner is also recorded
+    into the wisdom store when one is configured, so later runs can reuse
+    it via ``comm-method auto``."""
     if not getattr(args, "autotune_comm", False):
         return cfg
     if dims < 2:
@@ -122,17 +152,29 @@ def maybe_autotune_comm(args, kind, global_size, partition, cfg,
 
     print(f"autotuning comm strategies for {global_size.shape} "
           f"({kind}, {partition.num_ranks} ranks, dims={dims}):")
-    ranked = at.autotune_comm(kind, global_size, partition, cfg,
+    base = cfg  # the config the send=None candidates were actually timed on
+    ranked = at.autotune_comm(kind, global_size, partition, base,
                               sequence=sequence, dims=dims,
+                              transform=transform,
                               iterations=max(args.iterations, 3),
                               warmup=max(args.warmup_rounds, 1),
+                              race_send=True,
                               verbose=True)
     best = ranked[0]
-    cfg = at.apply_best_comm(ranked, cfg)
+    cfg = at.apply_best_comm(ranked, base)
     runner = ranked[1] if len(ranked) > 1 and ranked[1].ok else None
     delta = (f", {runner.total_ms - best.total_ms:+.3f} ms vs next "
              f"({runner.label})" if runner else "")
     print(f"best: {best.label} ({best.total_ms:.3f} ms roundtrip{delta})")
+    from ..utils import wisdom
+    store = wisdom.store_for_config(cfg)
+    if store is not None and best.ok:
+        key = wisdom.plan_key(kind, global_size.shape, cfg.double_prec,
+                              partition, cfg.norm, sequence=sequence,
+                              variant=variant, transform=transform,
+                              dims=dims)
+        if store.record(key, "comm", wisdom.comm_record(best, base)):
+            print(f"wisdom: comm winner recorded -> {store.path}")
     return cfg
 
 
@@ -202,8 +244,8 @@ def setup_backend(args) -> None:
         if getattr(args, "multihost", False):
             raise SystemExit("--multihost and --emulate-devices are mutually "
                              "exclusive (emulation is single-process)")
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.emulate_devices)
+        from ..parallel.mesh import force_cpu_devices
+        force_cpu_devices(args.emulate_devices)
     if getattr(args, "double_prec", False):
         jax.config.update("jax_enable_x64", True)
     if getattr(args, "multihost", False):

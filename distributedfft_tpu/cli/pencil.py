@@ -14,7 +14,7 @@ import argparse
 import sys
 
 from .common import (add_common_args, maybe_autotune_comm, run_testcase,
-                     setup_backend)
+                     setup_backend, wisdom_config_kwargs)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -40,20 +40,21 @@ def main(argv=None) -> int:
 
     g = pm.GlobalSize(args.input_dim_x, args.input_dim_y, args.input_dim_z)
     cfg = pm.Config(
-        comm_method=pm.CommMethod.parse(args.comm_method1),
+        comm_method=pm.parse_comm_method(args.comm_method1),
         send_method=pm.SendMethod.parse(args.send_method1),
-        comm_method2=(pm.CommMethod.parse(args.comm_method2)
+        comm_method2=(pm.parse_comm_method(args.comm_method2)
                       if args.comm_method2 else None),
         send_method2=(pm.SendMethod.parse(args.send_method2)
                       if args.send_method2 else None),
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
-        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks)
+        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks,
+        **wisdom_config_kwargs(args))
     part = pm.PencilPartition(args.partition1, args.partition2)
     cfg = maybe_autotune_comm(args, "pencil", g, part, cfg,
                               dims=args.fft_dim)
-    plan = tc.make_plan("pencil", g, part, cfg)
+    plan = tc.make_plan("pencil", g, part, cfg, dims=args.fft_dim)
     return run_testcase(plan, args, dims=args.fft_dim)
 
 

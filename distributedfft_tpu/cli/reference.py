@@ -76,6 +76,15 @@ def main(argv=None) -> int:
             return 1
         print(f"best: {best.label} ({best.per_iter_ms:.3f} ms/roundtrip, "
               f"rel_err {best.rel_err:.2e})")
+        # Persist the measured winner so later runs (bench.py warm-start,
+        # --fft-backend auto plans of this shape) reuse it instead of
+        # re-racing — the explicit "tune once" entry point.
+        from ..utils import wisdom
+        store = wisdom.open_store(args.wisdom, not args.no_wisdom)
+        if store is not None:
+            key = wisdom.local_key(shape, args.double_prec)
+            if store.record(key, "local_fft", wisdom.local_fft_record(best)):
+                print(f"wisdom: winner recorded -> {store.path}")
         return 0
 
     with maybe_profile(args):
@@ -88,8 +97,30 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
     from ..testing import microbench as mb
 
     if args.testcase == 0:
+        backend = args.fft_backend
+        settings = None
+        if backend == "auto":
+            # Bare single-device transform: resolve via the wisdom store
+            # (hit -> reuse, miss -> bounded race-and-record), mirroring
+            # what the plan constructors do for Config(fft_backend="auto").
+            from .. import params as pm
+            from ..utils import wisdom
+            backend, rec = wisdom.resolve_local_backend(
+                shape, args.double_prec, path=args.wisdom,
+                enabled=not args.no_wisdom)
+            src = "wisdom" if rec is not None else "fallback"
+            print(f"fft-backend auto -> {backend} ({src})")
+            if rec is not None:
+                # The gate/timing in the record were measured at the raced
+                # precision/direct_max — run the SAME program, not the
+                # backend at default MXU settings.
+                settings = pm.Config(
+                    fft_backend=backend,
+                    mxu_precision=rec.get("mxu_precision"),
+                    mxu_direct_max=rec.get("mxu_direct_max"),
+                ).mxu_settings()
         ms = mb.single_device_fft_ms(shape, it, wu, dtype,
-                                     backend=args.fft_backend)
+                                     backend=backend, settings=settings)
         print(f"Run complete: {ms:.4f} ms (single-device 3D R2C, "
               f"{shape[0]}x{shape[1]}x{shape[2]})")
         return 0

@@ -17,7 +17,7 @@ import argparse
 import sys
 
 from .common import (add_common_args, maybe_autotune_comm, run_testcase,
-                     setup_backend)
+                     setup_backend, wisdom_config_kwargs)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -43,12 +43,13 @@ def main(argv=None) -> int:
     p = args.partitions or len(jax.devices())
     g = pm.GlobalSize(args.input_dim_x, args.input_dim_y, args.input_dim_z)
     cfg = pm.Config(
-        comm_method=pm.CommMethod.parse(args.comm_method),
+        comm_method=pm.parse_comm_method(args.comm_method),
         send_method=pm.SendMethod.parse(args.send_method),
         opt=args.opt, cuda_aware=args.cuda_aware,
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
-        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks)
+        fft_backend=args.fft_backend, streams_chunks=args.streams_chunks,
+        **wisdom_config_kwargs(args))
     part = pm.SlabPartition(p)
     cfg = maybe_autotune_comm(args, "slab", g, part, cfg,
                               sequence=args.sequence)

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..ops import fft as local_fft
 from ..params import Config, FFTNorm, GlobalSize, Partition
+from ..utils import wisdom
 
 
 def _with_pad(pure, logical_shape, padded_shape):
@@ -68,6 +69,16 @@ class DistFFTPlan:
         self.global_size = global_size
         self.partition = partition
         self.config = config or Config()
+        if wisdom.unresolved(self.config):
+            # The engine constructors (SlabFFTPlan/PencilFFTPlan/
+            # Batched2DFFTPlan) resolve "auto" fields via wisdom before
+            # reaching here; a plan must never trace with an unresolved
+            # marker (ops.fft would reject the backend much later, with a
+            # far worse message).
+            raise ValueError(
+                "Config has unresolved 'auto' fields; construct plans "
+                "through the engine classes or resolve explicitly with "
+                "utils.wisdom.resolve_config(...)")
         # MXU settings resolved ONCE at plan construction: when any Config
         # knob is set, every builder reads this snapshot, so a later
         # deprecated set_* call cannot split the plan's forward and inverse

@@ -36,6 +36,7 @@ from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
                                   pad_axis_to, slice_axis_to,
                                   split_axis_chunks)
+from ..utils import wisdom
 from .base import _with_pad, jit_stages
 
 
@@ -66,6 +67,14 @@ class Batched2DFFTPlan:
             raise ValueError(f"transform must be 'r2c' or 'c2c', got {transform!r}")
         if batch <= 0 or nx <= 0 or ny <= 0:
             raise ValueError("batch/nx/ny must be positive")
+        if batch_chunk == 0:
+            batch_chunk = None  # documented alias: 0 = whole stack fused
+        # Wisdom resolution of "auto" Config fields (see SlabFFTPlan);
+        # shard='batch' issues no collectives, so its comm "auto" resolves
+        # to the defaults without a race.
+        config = wisdom.resolve_config(
+            "batched2d", pm.GlobalSize(batch, nx, ny), partition, config,
+            mesh=mesh, transform=transform, dims=2, variant=shard)
         if mesh is None and partition.p > 1:
             mesh = make_slab_mesh(partition.p)
         if mesh is not None and partition.p > 1 \

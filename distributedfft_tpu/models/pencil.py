@@ -56,6 +56,7 @@ from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
                                   pad_axis_to, slice_axis_to,
                                   split_axis_chunks)
+from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
 
 P1_AXIS, P2_AXIS = PENCIL_AXES
@@ -66,7 +67,17 @@ class PencilFFTPlan(DistFFTPlan):
 
     def __init__(self, global_size: pm.GlobalSize, partition: pm.PencilPartition,
                  config: Optional[pm.Config] = None, mesh: Optional[Mesh] = None,
-                 transform: str = "r2c"):
+                 transform: str = "r2c", dims: int = 3):
+        # Wisdom resolution of "auto" Config fields (see SlabFFTPlan): the
+        # comm race covers the pencil 2x2 (comm1 x comm2) matrix at dims=3.
+        # ``dims`` is a resolution hint ONLY — the partial-transform depth
+        # the run will execute (--fft-dim, an exec-time choice the plan
+        # itself is agnostic to); it keys the wisdom entry and bounds the
+        # race to the program that will actually run (at dims=2 only
+        # transpose 1 exists, so comm2 is not raced).
+        config = wisdom.resolve_config("pencil", global_size, partition,
+                                       config, mesh=mesh,
+                                       transform=transform, dims=dims)
         if mesh is None and partition.num_ranks > 1:
             mesh = make_pencil_mesh(partition.p1, partition.p2)
         if mesh is not None and partition.num_ranks > 1:

@@ -63,6 +63,7 @@ from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
                                   pad_axis_to, slice_axis_to,
                                   split_axis_chunks)
+from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
 
 
@@ -95,6 +96,13 @@ class SlabFFTPlan(DistFFTPlan):
                  config: Optional[pm.Config] = None, mesh: Optional[Mesh] = None,
                  sequence: "pm.SlabSequence | str" = pm.SlabSequence.ZY_THEN_X,
                  transform: str = "r2c"):
+        # Measurement-resolved Config fields (fft_backend="auto" /
+        # comm_method="auto") are settled HERE, before anything reads the
+        # config: wisdom hit -> reuse, miss -> bounded race-and-record
+        # (utils/wisdom.py). Concrete configs pass through untouched.
+        config = wisdom.resolve_config("slab", global_size, partition,
+                                       config, mesh=mesh, sequence=sequence,
+                                       transform=transform)
         if mesh is None and partition.p > 1:
             mesh = make_slab_mesh(partition.p)
         if mesh is not None and partition.p > 1:

@@ -222,6 +222,38 @@ def _f32_planes(F: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
             np.ascontiguousarray(F.imag.astype(np.float32)))
 
 
+def _sds(shape, dtype, vma):
+    """``ShapeDtypeStruct`` carrying the vma set where the runtime supports
+    it (jax >= 0.5); pre-vma runtimes take the bare struct — the set is
+    always empty there (see ``_vma``), so nothing is lost."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _vma(x) -> frozenset:
+    """The value's varying-across-mesh-axes set, or empty when the runtime
+    predates ``jax.typeof``/vma tracking (jax < 0.5, where shard_map has no
+    per-value vma and nothing needs lifting)."""
+    typeof = getattr(jax, "typeof", None)
+    return getattr(typeof(x), "vma", frozenset()) if typeof else frozenset()
+
+
+def _under_rewrite() -> bool:
+    """True inside shard_map's replication-checking rewrite on runtimes
+    predating vma tracking (jax < 0.5), where ``pallas_call`` has no
+    replication rule — the kernels' jnp-equivalent interpret fallback must
+    apply there. On vma runtimes ``_vma`` carries this signal instead."""
+    if hasattr(jax, "typeof"):
+        return False
+    try:
+        from jax._src import core as jcore
+        return type(jcore.trace_ctx.trace).__name__ in ("RewriteTrace",
+                                                        "ShardMapTrace")
+    except Exception:  # noqa: BLE001 — unknown internals: assume plain trace
+        return False
+
+
 def _lift_vma(args, vma):
     """Under shard_map every kernel operand must carry the same
     varying-across-mesh-axes set; lift replicated constants to match the
@@ -230,7 +262,7 @@ def _lift_vma(args, vma):
         return args
 
     def one(a):
-        missing = vma - getattr(jax.typeof(a), "vma", frozenset())
+        missing = vma - _vma(a)
         return lax.pvary(a, tuple(missing)) if missing else a
 
     return [one(a) for a in args]
@@ -247,7 +279,7 @@ def _call_stage(x2, F_np: np.ndarray, twiddle: "Tuple[int, int, bool] | None"):
     k = F_np.shape[1]
     real_in = not jnp.issubdtype(x2.dtype, jnp.complexfloating)
 
-    if _interpret() and getattr(jax.typeof(x2), "vma", frozenset()):
+    if _interpret() and (_vma(x2) or _under_rewrite()):
         # Pallas's HLO interpreter cannot yet thread shard_map's vma through
         # its internal grid loop carries; off-TPU, inside shard_map, compute
         # the stage with the equivalent jnp ops (the compiled Mosaic path on
@@ -278,8 +310,8 @@ def _call_stage(x2, F_np: np.ndarray, twiddle: "Tuple[int, int, bool] | None"):
     out_spec = pl.BlockSpec((tb, k), lambda i: (i, 0))
     # Propagate the input's varying-across-mesh-axes set so the kernel works
     # under shard_map's vma checking (per-shard data varies over the mesh).
-    vma = getattr(jax.typeof(x2), "vma", frozenset())
-    out_shape = [jax.ShapeDtypeStruct((m_pad, k), jnp.float32, vma=vma)] * 2
+    vma = _vma(x2)
+    out_shape = [_sds((m_pad, k), jnp.float32, vma)] * 2
 
     flops_c = (2 if real_in else 4) * 2 * m_pad * n * k
     cost = pl.CostEstimate(flops=flops_c, transcendentals=0,
@@ -329,7 +361,7 @@ def _c2r_stage(c, n: int):
     CR, CI = mx._c2r_np(n, False)
     xr, xi = jnp.real(c2), jnp.imag(c2)
 
-    if _interpret() and getattr(jax.typeof(c2), "vma", frozenset()):
+    if _interpret() and (_vma(c2) or _under_rewrite()):
         y2 = (jnp.matmul(xr, jnp.asarray(CR), precision=_prec())
               - jnp.matmul(xi, jnp.asarray(CI), precision=_prec()))
         return y2.reshape(lead + (n,))
@@ -339,7 +371,7 @@ def _c2r_stage(c, n: int):
     if m_pad != m:
         xr = jnp.pad(xr, [(0, m_pad - m), (0, 0)])
         xi = jnp.pad(xi, [(0, m_pad - m), (0, 0)])
-    vma = getattr(jax.typeof(c2), "vma", frozenset())
+    vma = _vma(c2)
     row_spec = pl.BlockSpec((tb, n_in), lambda i: (i, 0))
     const_spec = pl.BlockSpec((n_in, n), lambda i: (0, 0))
     out_spec = pl.BlockSpec((tb, n), lambda i: (i, 0))
@@ -349,7 +381,7 @@ def _c2r_stage(c, n: int):
         grid=(m_pad // tb,),
         in_specs=[row_spec, row_spec, const_spec, const_spec],
         out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((m_pad, n), jnp.float32, vma=vma),
+        out_shape=_sds((m_pad, n), jnp.float32, vma),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * m_pad * n_in * n, transcendentals=0,
             bytes_accessed=4 * (m_pad * (2 * n_in + n) + 2 * n_in * n)),
@@ -516,8 +548,7 @@ def _x_transform(yr, yi, inverse: bool, vma):
                   pl.BlockSpec((X, X), lambda i: (0, 0)),
                   pl.BlockSpec((X, X), lambda i: (0, 0))],
         out_specs=[pl.BlockSpec((X, tk, Zo), lambda i: (0, i, 0))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((X, Kp, Zo), jnp.float32,
-                                        vma=vma)] * 2,
+        out_shape=[_sds((X, Kp, Zo), jnp.float32, vma)] * 2,
         cost_estimate=pl.CostEstimate(
             flops=4 * X * X * Kp * Zo * 2, transcendentals=0,
             bytes_accessed=4 * X * Kp * Zo * 4),
@@ -530,7 +561,7 @@ def _rfftn3d_fused(x):
     """(X, Y, Z) f32 -> (X, Y, Z//2+1) c64, unnormalized forward."""
     X, Y, Z = x.shape
     Zo = Z // 2 + 1
-    vma = getattr(jax.typeof(x), "vma", frozenset())
+    vma = _vma(x)
 
     # Pass 1: fused z-R2C + y-C2C, grid over x blocks. The per-row working
     # set is the input plane, the two output planes, AND the two in-kernel
@@ -552,8 +583,7 @@ def _rfftn3d_fused(x):
                   pl.BlockSpec((Y, Y), lambda i: (0, 0)),
                   pl.BlockSpec((Y, Y), lambda i: (0, 0))],
         out_specs=[pl.BlockSpec((B, Y, Zo), lambda i: (i, 0, 0))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((Xp, Y, Zo), jnp.float32,
-                                        vma=vma)] * 2,
+        out_shape=[_sds((Xp, Y, Zo), jnp.float32, vma)] * 2,
         cost_estimate=pl.CostEstimate(
             flops=2 * Xp * Y * Z * Zo * 2 + 4 * Xp * Y * Y * Zo * 2,
             transcendentals=0,
@@ -572,7 +602,7 @@ def _irfftn3d_fused(c, shape_3d):
     c = c.astype(jnp.complex64)
     for ax, n in ((-3, X), (-2, Y), (-1, Zo)):
         c = mx._fit_axis(c, ax, n)
-    vma = getattr(jax.typeof(c), "vma", frozenset())
+    vma = _vma(c)
 
     # Pass 1: x-C2C inverse contraction.
     er, ei = _x_transform(jnp.real(c), jnp.imag(c), True, vma)
@@ -597,7 +627,7 @@ def _irfftn3d_fused(c, shape_3d):
                   pl.BlockSpec((Zo, Z), lambda i: (0, 0)),
                   pl.BlockSpec((Zo, Z), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((B, Y, Z), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((Xp, Y, Z), jnp.float32, vma=vma),
+        out_shape=_sds((Xp, Y, Z), jnp.float32, vma),
         scratch_shapes=[pltpu.VMEM((B, Y, Zo), jnp.float32)] * 2,
         cost_estimate=pl.CostEstimate(
             flops=4 * Xp * Y * Y * Zo * 2 + 2 * Xp * Y * Zo * Z * 2,
@@ -717,7 +747,7 @@ def _fused3d_usable(x, shape3) -> bool:
     # direct sizes.
     return (fused3d_applicable(shape3, x.dtype)
             and not (_interpret()
-                     and getattr(jax.typeof(x), "vma", frozenset())))
+                     and (_vma(x) or _under_rewrite())))
 
 
 def rfftn_3d(x, norm: FFTNorm = FFTNorm.NONE):

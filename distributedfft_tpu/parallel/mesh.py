@@ -24,6 +24,24 @@ SLAB_AXIS = "p"
 PENCIL_AXES = ("p1", "p2")
 
 
+def force_cpu_devices(n: int) -> None:
+    """Select the CPU platform with ``n`` virtual devices, portably across
+    jax releases: ``jax_num_cpu_devices`` exists from jax 0.5; older
+    runtimes only honor ``XLA_FLAGS=--xla_force_host_platform_device_count``
+    (which must land before the CPU backend initializes, so call this
+    before the first device query)."""
+    import os
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # jax < 0.5
+        opt = "--xla_force_host_platform_device_count"
+        kept = [t for t in os.environ.get("XLA_FLAGS", "").split()
+                if not t.startswith(opt + "=")]
+        os.environ["XLA_FLAGS"] = " ".join(kept + [f"{opt}={n}"])
+
+
 def _topology_mesh(shape: Tuple[int, ...]):
     """ICI/DCN-aware device ordering via ``mesh_utils.create_device_mesh``
     when the mesh spans every device (the multi-host pod case, where naive

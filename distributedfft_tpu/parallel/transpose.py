@@ -24,6 +24,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, portable across jax releases:
+    ``lax.axis_size`` only exists from jax 0.5; older runtimes constant-fold
+    ``psum(1, axis)`` to the same Python int inside shard_map."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:  # jax < 0.5
+        return lax.psum(1, axis_name)
+
+
 def pad_axis_to(x, axis: int, target: int):
     """Zero-pad ``axis`` up to ``target`` extent (no-op when already there)."""
     cur = x.shape[axis]
@@ -165,7 +175,7 @@ def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
     if not realigned:
         return lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     s, c = split_axis, concat_axis
     shp = x.shape
     if shp[s] % p:

@@ -44,6 +44,22 @@ from .utils import native_planner
 # set so params.py stays importable without jax.
 _MXU_PRECISIONS = frozenset({"default", "high", "highest"})
 
+# Marker for measurement-resolved Config fields: ``fft_backend=AUTO`` /
+# ``comm_method=AUTO`` ask the plan constructors to consult the persistent
+# wisdom store (``utils/wisdom.py``) and race-and-record on a miss. Plans
+# never execute with an unresolved AUTO; resolution happens once, at
+# construction.
+AUTO = "auto"
+
+
+def parse_comm_method(s: "str | CommMethod") -> "str | CommMethod":
+    """``CommMethod.parse`` that additionally accepts ``"auto"`` (the
+    wisdom-resolved marker, owning the whole comm x send x opt x chunk
+    variant choice at plan construction)."""
+    if isinstance(s, str) and s.strip().lower() == AUTO:
+        return AUTO
+    return CommMethod.parse(s)
+
 
 class CommMethod(enum.Enum):
     """Global-redistribution strategy (reference ``params.hpp:83-85``)."""
@@ -273,7 +289,14 @@ class Config:
     down to MXU-depth matmuls), or ``"pallas"`` (Pallas kernels fusing the
     four-step twiddle into the DFT matmul, ``ops/pallas_fft.py``) — the TPU
     analog of the reference's cuFFT-plan choice at L0
-    (``include/cufft.hpp:23-61``).
+    (``include/cufft.hpp:23-61``). ``fft_backend="auto"`` defers the choice
+    to measurement: plan construction consults the persistent wisdom store
+    (``utils/wisdom.py``; path from ``wisdom_path`` -> ``$DFFT_WISDOM``),
+    races the backends on a miss and records the winner. ``comm_method=
+    "auto"`` does the same for the whole comm x send x opt x streams-chunks
+    variant (ignoring the explicit ``send_method``/``opt`` fields — the
+    race owns them). ``use_wisdom=False`` (CLI ``--no-wisdom``) never
+    touches disk; "auto" then races per process.
 
     ``streams_chunks`` sets how many pieces the ``SendMethod.STREAMS``
     pipelined transpose splits the local block into (None -> 4). Ignored
@@ -325,10 +348,24 @@ class Config:
     mxu_direct_max: Optional[int] = None
     fft3d_chunk: Optional[int] = None
     streams_chunks: Optional[int] = None
+    wisdom_path: Optional[str] = None
+    use_wisdom: bool = True
 
     def __post_init__(self):
         from .ops.fft import validate_backend  # lazy: ops.fft imports params
-        validate_backend(self.fft_backend)
+        if self.fft_backend != AUTO:
+            validate_backend(self.fft_backend)
+        if not (isinstance(self.comm_method, CommMethod)
+                or self.comm_method == AUTO):
+            raise ValueError(
+                f"comm_method must be a CommMethod or {AUTO!r}, "
+                f"got {self.comm_method!r}")
+        if not (self.comm_method2 is None
+                or isinstance(self.comm_method2, CommMethod)
+                or self.comm_method2 == AUTO):
+            raise ValueError(
+                f"comm_method2 must be a CommMethod, {AUTO!r} or None, "
+                f"got {self.comm_method2!r}")
         if self.mxu_precision is not None and \
                 str(self.mxu_precision).lower() not in _MXU_PRECISIONS:
             raise ValueError(

@@ -184,10 +184,16 @@ def describe_failures(candidates: List[Candidate]) -> str:
 class CommCandidate:
     """One point of the comm-strategy matrix (the reference's primary
     comparative dimension, ``include/mpicufft_slab.hpp:145-158``): global-
-    redistribution strategy per transpose x data-layout opt."""
+    redistribution strategy per transpose x data-layout opt, optionally
+    crossed with the send-method axis (``send``/``chunks``: the STREAMS
+    chunked-pipelined transpose at a given piece count; ``send=None`` keeps
+    the base config's monolithic SYNC exchange — the reference's
+    ``-snd``/``-snd2`` dimension)."""
     comm: object                 # CommMethod for transpose 1
     comm2: Optional[object]      # pencil transpose 2 (None for slab)
     opt: int
+    send: object = None          # SendMethod.STREAMS variants only
+    chunks: Optional[int] = None  # streams_chunks for send=STREAMS
     fwd_ms: float = float("nan")
     inv_ms: float = float("nan")
     ok: bool = False
@@ -201,7 +207,10 @@ class CommCandidate:
     def label(self) -> str:
         c1 = self.comm.value
         tag = c1 if self.comm2 is None else f"{c1}+{self.comm2.value}"
-        return f"{tag}/opt{self.opt}"
+        tag = f"{tag}/opt{self.opt}"
+        if self.send is not None:
+            tag += f"/streams{self.chunks}"
+        return tag
 
 
 def _time_plan_ms(fn, x, iterations: int, warmup: int) -> float:
@@ -218,6 +227,8 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
                   mesh=None, sequence=None, iterations: int = 5,
                   warmup: int = 2, race_opt: bool = True, seed: int = 0,
                   dims: int = 3, transform: str = "r2c",
+                  race_send: bool = False,
+                  streams_chunks: Sequence[int] = (4,),
                   verbose: bool = False) -> List[CommCandidate]:
     """Race the communication strategies for a plan shape ON the active
     mesh: ALL2ALL (explicit ``lax.all_to_all``) vs PEER2PEER (GSPMD
@@ -231,6 +242,13 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
     transpose 1 runs, so comm2 is not raced (it would be noise), and at
     dims=1 there is no transpose at all (every candidate ties).
 
+    ``race_send=True`` adds the send-method axis: each ALL2ALL point also
+    races the STREAMS chunked-pipelined transpose at every piece count in
+    ``streams_chunks`` (the reference's ``-snd`` dimension). PEER2PEER
+    points are not crossed — GSPMD re-fuses piece reshards into one
+    collective (measured, ``models/slab._assemble_pure``), so a
+    P2P+STREAMS candidate would mismeasure a program identical to SYNC.
+
     Returns candidates sorted by measured forward+inverse time; apply the
     winner with ``apply_best_comm``.
     """
@@ -238,7 +256,7 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
 
     import numpy as np
 
-    from ..params import CommMethod, Config
+    from ..params import CommMethod, Config, SendMethod
     from . import testcases as tc
 
     base = base_config or Config()
@@ -248,10 +266,15 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
     cands: List[CommCandidate] = []
     for opt in opts:
         for c1 in both:
-            if race_comm2:
-                cands += [CommCandidate(c1, c2, opt) for c2 in both]
-            else:
-                cands.append(CommCandidate(c1, None, opt))
+            pairs = [(c1, c2) for c2 in both] if race_comm2 else [(c1, None)]
+            for cc1, cc2 in pairs:
+                cands.append(CommCandidate(cc1, cc2, opt))
+                if (race_send and cc1 is CommMethod.ALL2ALL
+                        and cc2 in (None, CommMethod.ALL2ALL)):
+                    cands += [CommCandidate(cc1, cc2, opt,
+                                            send=SendMethod.STREAMS,
+                                            chunks=int(k))
+                              for k in streams_chunks if k and int(k) > 1]
 
     rdt = np.float64 if base.double_prec else np.float32
     xs = np.random.default_rng(seed).random(
@@ -260,6 +283,9 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
         try:
             cfg = dc.replace(base, comm_method=c.comm, comm_method2=c.comm2,
                              opt=c.opt)
+            if c.send is not None:
+                cfg = dc.replace(cfg, send_method=c.send, send_method2=None,
+                                 streams_chunks=c.chunks)
             plan = tc.make_plan(kind, global_size, partition, cfg,
                                 sequence=sequence, mesh=mesh,
                                 transform=transform)
@@ -323,6 +349,13 @@ def apply_best_comm(candidates: List[CommCandidate], base_config=None):
         # otherwise a user's explicit --comm-method2 must survive, or the
         # benchmark CSVs get filed under a strategy nobody selected.
         cfg = dc.replace(cfg, comm_method2=best.comm2)
+    if best.send is not None:
+        # The send axis was raced (race_send) and a STREAMS variant won:
+        # the piece count travels with it — send=None keeps the base
+        # config's send method (a SYNC win must not clobber an explicit
+        # --send-method the caller chose not to race).
+        cfg = dc.replace(cfg, send_method=best.send, send_method2=None,
+                         streams_chunks=best.chunks)
     return cfg
 
 

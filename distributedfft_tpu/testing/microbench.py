@@ -522,23 +522,27 @@ def transpose_bandwidth(shape, p: int, explicit: bool = True,
 
 def single_device_fft_ms(shape, iterations: int = 10, warmup: int = 2,
                          dtype=np.float32, inverse: bool = False,
-                         backend: str = "xla") -> float:
+                         backend: str = "xla", settings=None) -> float:
     """Reference testcase 0 analog: full 3D FFT of ``shape = (nx, ny, nz)``
     on one device (the cufftMakePlan3d baseline curve). Input is staged on
     device once. ``backend`` selects the local transform implementation
     (``ops/fft.py`` ``BACKENDS``: "xla", "matmul", "matmul-r2", or
-    "pallas")."""
+    "pallas"); ``settings`` an optional ``mxu_fft.MXUSettings`` so a
+    measured matmul winner (precision/direct_max) runs AS measured."""
     from ..ops import fft as lf
 
     lf.validate_backend(backend)
     shape = tuple(shape)
     x = jax.device_put(np.random.default_rng(0).random(shape).astype(dtype))
     if inverse:
-        c = jax.jit(lambda a: lf.rfftn_3d(a, backend=backend))(x)
+        c = jax.jit(lambda a: lf.rfftn_3d(a, backend=backend,
+                                          settings=settings))(x)
         jax.block_until_ready(c)
-        fn = jax.jit(lambda a: lf.irfftn_3d(a, shape, backend=backend))
+        fn = jax.jit(lambda a: lf.irfftn_3d(a, shape, backend=backend,
+                                            settings=settings))
         dt = _time_fn(fn, c, iterations, warmup)
     else:
-        fn = jax.jit(lambda a: lf.rfftn_3d(a, backend=backend))
+        fn = jax.jit(lambda a: lf.rfftn_3d(a, backend=backend,
+                                           settings=settings))
         dt = _time_fn(fn, x, iterations, warmup)
     return dt * 1e3

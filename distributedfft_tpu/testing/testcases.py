@@ -44,10 +44,13 @@ from . import sharded
 
 
 def make_plan(kind: str, global_size: pm.GlobalSize, partition, config,
-              sequence=None, mesh=None, transform: str = "r2c"):
+              sequence=None, mesh=None, transform: str = "r2c",
+              dims: int = 3):
     """``transform`` must match the program the caller will actually run
     (the comm autotuner races THIS plan — a c2c run tuned on an r2c plan
-    would time transposes moving roughly half the bytes)."""
+    would time transposes moving roughly half the bytes). ``dims`` is the
+    pencil partial-transform depth hint for wisdom resolution (exec-time
+    choice; ignored by the other kinds)."""
     if kind == "slab":
         return SlabFFTPlan(global_size, partition, config, mesh=mesh,
                            sequence=sequence or pm.SlabSequence.ZY_THEN_X,
@@ -60,7 +63,7 @@ def make_plan(kind: str, global_size: pm.GlobalSize, partition, config,
                                 mesh=mesh, shard="x", transform=transform)
     if kind == "pencil":
         return PencilFFTPlan(global_size, partition, config, mesh=mesh,
-                             transform=transform)
+                             transform=transform, dims=dims)
     raise ValueError(f"unknown plan kind {kind!r}")
 
 

@@ -1,0 +1,584 @@
+"""Persistent plan "wisdom": autotune once, reuse everywhere.
+
+FFTW saves its planner measurements as *wisdom*; XLA amortizes compilation
+through its persistent compilation cache (bench.py wires it). This module is
+the same amortization for THIS framework's two measured plan choices:
+
+* the local-FFT backend race (``testing/autotune.autotune_local_fft`` —
+  a measured 3.3x spread between backends on v5e, see its docstring), and
+* the comm-variant race (``testing/autotune.autotune_comm`` with
+  ``race_send=True`` — comm_method x send_method x opt x streams-chunks,
+  the reference's primary comparative dimension).
+
+The reference pays its tuning once per plan (``cufftMakePlanMany64`` picks
+kernels at plan creation); our port previously re-raced on every process
+start. With wisdom, ``Config(fft_backend="auto")`` / ``Config(comm_method=
+"auto")`` plans consult the store at construction, race-and-record on a
+miss (bounded iterations, accuracy-gated exactly like the underlying
+autotuners), and reuse silently on a hit — steady-state plan creation costs
+zero measurement time.
+
+Store format: ONE JSON file::
+
+    {"version": 1,
+     "entries": {"<canonical key json>": {"local_fft": {...}, "comm": {...}}}}
+
+Keys fold in everything that can change a winner: platform, device kind,
+jax version, global shape, dtype, mesh shape, decomposition (kind +
+partition grid + sequence/variant + transform), and norm. A key built on a
+different mesh, dtype or jax version simply misses.
+
+Degradation contract: a missing, corrupt, partially-valid or
+version-mismatched store reads as EMPTY (re-measure); a record whose fields
+no longer validate (e.g. a backend this build doesn't know) is a miss; a
+failed write is swallowed after a best-effort retry. Wisdom can cost a
+redundant measurement, never an error. Writes are atomic (tmp +
+``os.replace``) and merge from a fresh read of the on-disk entries, so a
+reader never sees a torn file — but the read-merge-replace window is not
+locked, so of two processes recording concurrently one update can be lost
+(and is simply re-measured by a later miss; wisdom loses measurements,
+never correctness).
+
+The store path resolves as ``Config.wisdom_path`` -> ``$DFFT_WISDOM`` ->
+disabled. ``Config(use_wisdom=False)`` (CLI ``--no-wisdom``) never touches
+disk; "auto" then races per process like before wisdom existed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+WISDOM_VERSION = 1
+ENV_VAR = "DFFT_WISDOM"
+
+# Bounded construction-time race defaults. The local chain length is the
+# floor that still cancels dispatch noise on CPU-class timers; raise
+# DFFT_WISDOM_K on the TPU tunnel where only long chains dominate its
+# tens-of-ms constant noise (chaintimer docstring).
+_RACE_REPEATS = 2
+_RACE_INNER = 2
+_COMM_ITERATIONS = 3
+_COMM_WARMUP = 1
+_FALLBACK_BACKEND = "xla"  # when every candidate fails the gate
+
+
+def _race_k() -> int:
+    try:
+        return max(2, int(os.environ.get("DFFT_WISDOM_K", "17")))
+    except ValueError:
+        return 17
+
+
+def default_path() -> Optional[str]:
+    """Store path from ``$DFFT_WISDOM`` (empty/unset -> wisdom disabled)."""
+    p = os.environ.get(ENV_VAR, "").strip()
+    return p or None
+
+
+def open_store(path: Optional[str] = None,
+               enabled: bool = True) -> Optional["WisdomStore"]:
+    """A store for an explicit path (or the env default), or None when
+    disabled / no path is configured."""
+    if not enabled:
+        return None
+    p = path or default_path()
+    return WisdomStore(p) if p else None
+
+
+def store_for_config(config) -> Optional["WisdomStore"]:
+    """The store a Config selects (``wisdom_path``/``use_wisdom`` fields)."""
+    return open_store(getattr(config, "wisdom_path", None),
+                      getattr(config, "use_wisdom", True))
+
+
+class WisdomStore:
+    """One JSON wisdom file; every read is tolerant, every write atomic."""
+
+    def __init__(self, path: str):
+        self.path = os.path.expanduser(str(path))
+
+    # -- raw I/O -----------------------------------------------------------
+
+    @staticmethod
+    def _empty() -> Dict[str, Any]:
+        return {"version": WISDOM_VERSION, "entries": {}}
+
+    def load(self) -> Dict[str, Any]:
+        """Parsed store; ANY defect (missing file, malformed JSON, wrong
+        schema or version) degrades to the empty store."""
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return self._empty()
+        if (not isinstance(raw, dict)
+                or raw.get("version") != WISDOM_VERSION
+                or not isinstance(raw.get("entries"), dict)):
+            return self._empty()
+        return raw
+
+    def lookup(self, key: str, slot: str) -> Optional[Dict[str, Any]]:
+        """The recorded dict under ``entries[key][slot]``, or None."""
+        entry = self.load()["entries"].get(key)
+        if not isinstance(entry, dict):
+            return None
+        rec = entry.get(slot)
+        return rec if isinstance(rec, dict) else None
+
+    def record(self, key: str, slot: str, rec: Dict[str, Any]) -> bool:
+        """Merge ``rec`` into the on-disk store atomically. Best-effort:
+        returns False (never raises) when the write cannot land."""
+        try:
+            data = self.load()  # re-read: merge with concurrent writers
+            entry = data["entries"].setdefault(key, {})
+            if not isinstance(entry, dict):  # damaged entry: replace
+                entry = data["entries"][key] = {}
+            entry[slot] = rec
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                os.replace(tmp, self.path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+            return True
+        except (OSError, TypeError, ValueError):
+            return False
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+def _device_fingerprint() -> Dict[str, str]:
+    import jax
+    d = jax.devices()[0]
+    return {"platform": str(d.platform),
+            "device_kind": str(getattr(d, "device_kind", d.platform)),
+            "jax": jax.__version__}
+
+
+def _decomp_desc(kind: str, partition, sequence=None,
+                 variant: Optional[str] = None) -> str:
+    from .. import params as pm
+    if isinstance(partition, pm.PencilPartition):
+        grid = f"{partition.p1}x{partition.p2}"
+    else:
+        grid = str(partition.num_ranks)
+    desc = f"{kind}:{grid}"
+    if sequence is not None:
+        desc += f":{pm.SlabSequence.parse(sequence).value}"
+    if variant:
+        desc += f":{variant}"
+    return desc
+
+
+def plan_key(kind: str, global_shape: Sequence[int], double_prec: bool,
+             partition, norm, transform: str = "r2c", sequence=None,
+             variant: Optional[str] = None,
+             mesh_shape: Optional[Dict[str, int]] = None,
+             dims: int = 3) -> str:
+    """Canonical store key for one plan configuration: platform, device
+    kind, jax version, global shape, dtype, mesh shape, decomposition,
+    norm (+ transform and partial-transform depth ``dims`` — a pencil
+    ``--fft-dim 2`` race times a transpose-1-only program, so its winner
+    must not be reused by a full-3D plan). ``mesh_shape`` defaults to the
+    mesh the partition itself determines, so recorders without a mesh in
+    hand (the CLIs) and plan-construction lookups build the same key."""
+    parts = dict(_device_fingerprint())
+    parts.update({
+        "shape": list(int(s) for s in global_shape),
+        "dtype": "f64" if double_prec else "f32",
+        "mesh": (mesh_shape if mesh_shape is not None
+                 else _mesh_shape_of(None, partition)),
+        "decomp": _decomp_desc(kind, partition, sequence, variant),
+        "norm": getattr(norm, "value", str(norm)),
+        "transform": transform,
+        "dims": int(dims),
+    })
+    return json.dumps(parts, sort_keys=True, separators=(",", ":"))
+
+
+def local_key(shape: Sequence[int], double_prec: bool) -> str:
+    """Key for a bare single-device local-FFT race (no plan around it):
+    what ``dfft-reference --autotune`` records and bench.py warm-starts
+    from."""
+    parts = dict(_device_fingerprint())
+    parts.update({"shape": list(int(s) for s in shape),
+                  "dtype": "f64" if double_prec else "f32",
+                  "decomp": "local-fft", "mesh": {}})
+    return json.dumps(parts, sort_keys=True, separators=(",", ":"))
+
+
+def _mesh_shape_of(mesh, partition) -> Dict[str, int]:
+    if mesh is not None:
+        return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    # The mesh a plan WILL build is fully determined by the partition.
+    from .. import params as pm
+    from ..parallel.mesh import PENCIL_AXES, SLAB_AXIS
+    if isinstance(partition, pm.PencilPartition):
+        return {PENCIL_AXES[0]: partition.p1, PENCIL_AXES[1]: partition.p2}
+    if partition.num_ranks > 1:
+        return {SLAB_AXIS: partition.num_ranks}
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# record helpers (shared by resolution, the CLIs and bench.py)
+# ---------------------------------------------------------------------------
+
+def local_fft_record(candidate) -> Dict[str, Any]:
+    """Serialize a winning ``autotune.Candidate``."""
+    import numpy as np
+    rec = {"fft_backend": candidate.backend,
+           "mxu_precision": candidate.precision,
+           "mxu_direct_max": candidate.direct_max}
+    if np.isfinite(candidate.per_iter_ms):
+        rec["per_iter_ms"] = round(float(candidate.per_iter_ms), 4)
+    if np.isfinite(candidate.rel_err):
+        rec["rel_err"] = float(f"{candidate.rel_err:.3e}")
+    return rec
+
+
+def comm_record(candidate, base_config=None) -> Dict[str, Any]:
+    """Serialize a winning ``autotune.CommCandidate``. ``send=None``
+    candidates were timed with the BASE config's send method; pass the base
+    that was actually raced (``base_config``) so a non-SYNC base (the CLI
+    ``--autotune-comm -snd Streams`` case) is recorded as the send method
+    the measurement really used — a later "auto" fold must reproduce the
+    timed program, not silently swap in SYNC."""
+    import numpy as np
+
+    from .. import params as pm
+    rec = {"comm_method": candidate.comm.value,
+           "comm_method2": (candidate.comm2.value
+                            if candidate.comm2 is not None else None),
+           "opt": int(candidate.opt),
+           "send_method": (candidate.send.value
+                           if candidate.send is not None else None),
+           "streams_chunks": candidate.chunks}
+    if candidate.send is None and base_config is not None:
+        sm = getattr(base_config, "send_method", None)
+        if isinstance(sm, pm.SendMethod) and sm is not pm.SendMethod.SYNC:
+            rec["send_method"] = sm.value
+            rec["streams_chunks"] = base_config.streams_chunks
+    if np.isfinite(candidate.total_ms):
+        rec["total_ms"] = round(float(candidate.total_ms), 4)
+    return rec
+
+
+def _valid_local_rec(rec: Dict[str, Any]) -> bool:
+    from ..ops.fft import BACKENDS
+    if rec.get("fft_backend") not in BACKENDS:
+        return False
+    prec = rec.get("mxu_precision")
+    if prec is not None and str(prec).lower() not in ("default", "high",
+                                                      "highest"):
+        return False
+    dm = rec.get("mxu_direct_max")
+    return dm is None or (isinstance(dm, int) and dm >= 1)
+
+
+def _fold_local_rec(cfg, rec):
+    import dataclasses as dc
+    return dc.replace(cfg, fft_backend=rec["fft_backend"],
+                      mxu_precision=rec.get("mxu_precision"),
+                      mxu_direct_max=rec.get("mxu_direct_max"))
+
+
+def _fold_comm_rec(cfg, rec):
+    """Fold a stored comm record into a Config; raises on stale/invalid
+    fields (callers treat that as a miss)."""
+    import dataclasses as dc
+
+    from .. import params as pm
+    comm = pm.CommMethod.parse(rec["comm_method"])
+    comm2 = (pm.CommMethod.parse(rec["comm_method2"])
+             if rec.get("comm_method2") else None)
+    opt = int(rec.get("opt", 0))
+    if opt not in (0, 1):
+        raise ValueError(f"stale opt {opt}")
+    cfg = dc.replace(cfg, comm_method=comm, comm_method2=comm2, opt=opt)
+    if rec.get("send_method"):
+        chunks = rec.get("streams_chunks")
+        if chunks is not None and (not isinstance(chunks, int) or chunks < 1):
+            raise ValueError(f"stale streams_chunks {chunks!r}")
+        cfg = dc.replace(cfg, send_method=pm.SendMethod.parse(
+            rec["send_method"]), send_method2=None, streams_chunks=chunks)
+    return cfg
+
+
+def resolve_local_backend(shape: Sequence[int], double_prec: bool = False,
+                          path: Optional[str] = None, enabled: bool = True,
+                          race_on_miss: bool = True,
+                          default: str = _FALLBACK_BACKEND,
+                          ) -> Tuple[str, Optional[Dict[str, Any]]]:
+    """``(backend, record-or-None)`` for a BARE single-device transform of
+    ``shape`` (no plan around it — the ``dfft-reference`` testcase-0 path
+    and bench.py's warm-start): wisdom hit -> the recorded winner; miss ->
+    bounded race-and-record when ``race_on_miss`` (else ``default``); any
+    failure degrades to ``default``."""
+    store = open_store(path, enabled)
+    key = local_key(shape, double_prec)
+    rec = store.lookup(key, "local_fft") if store else None
+    if rec is not None and _valid_local_rec(rec):
+        return rec["fft_backend"], rec
+    if not race_on_miss:
+        return default, None
+    from ..testing import autotune as at
+    try:
+        ranked = at.autotune_local_fft(shape, k=_race_k(),
+                                       repeats=_RACE_REPEATS,
+                                       inner=_RACE_INNER,
+                                       double_prec=double_prec)
+    except Exception:  # noqa: BLE001 — wisdom degrades, never errors
+        return default, None
+    if not ranked or not ranked[0].ok:
+        return default, None
+    best = ranked[0]
+    rec = local_fft_record(best)
+    if store:
+        store.record(key, "local_fft", rec)
+    return best.backend, rec
+
+
+# ---------------------------------------------------------------------------
+# construction-time resolution of Config "auto" fields
+# ---------------------------------------------------------------------------
+
+def unresolved(config) -> bool:
+    """True when the Config still carries an 'auto' the engines should have
+    resolved at plan construction."""
+    from .. import params as pm
+    return pm.AUTO in (config.fft_backend, config.comm_method,
+                       config.comm_method2)
+
+
+def _race_shape(kind: str, global_size, partition,
+                variant: Optional[str]) -> Tuple[int, ...]:
+    """The per-rank block the plan's local transforms actually see — what
+    the local-FFT race should time (racing the full global cube on one
+    device would both mis-rank and risk OOM at scale)."""
+    from .. import params as pm
+    shape = list(global_size.shape)
+    if isinstance(partition, pm.PencilPartition):
+        shape[0] = max(1, pm.padded_extent(shape[0], partition.p1)
+                       // partition.p1)
+        shape[1] = max(1, pm.padded_extent(shape[1], partition.p2)
+                       // partition.p2)
+    elif partition.num_ranks > 1:
+        # Slab decomposes x (slot 0). Batched2d slots are (batch, nx, ny):
+        # shard='batch' decomposes slot 0, shard='x' slot 1.
+        ax = 1 if (kind == "batched2d" and variant == "x") else 0
+        p = partition.num_ranks
+        shape[ax] = max(1, pm.padded_extent(shape[ax], p) // p)
+    return tuple(shape)
+
+
+def _resolve_local_fft(cfg, store, key, kind, global_size, partition,
+                       variant):
+    import dataclasses as dc
+
+    rec = store.lookup(key, "local_fft") if store else None
+    if rec is not None and _valid_local_rec(rec):
+        return _fold_local_rec(cfg, rec)
+    from ..testing import autotune as at
+    shape = _race_shape(kind, global_size, partition, variant)
+    best = None
+    try:
+        ranked = at.autotune_local_fft(shape, k=_race_k(),
+                                       repeats=_RACE_REPEATS,
+                                       inner=_RACE_INNER,
+                                       double_prec=cfg.double_prec)
+        if ranked and ranked[0].ok:
+            best = ranked[0]
+    except Exception:  # noqa: BLE001 — wisdom degrades, never errors
+        best = None
+    if best is None:
+        return dc.replace(cfg, fft_backend=_FALLBACK_BACKEND)
+    cfg = dc.replace(cfg, fft_backend=best.backend,
+                     mxu_precision=best.precision,
+                     mxu_direct_max=best.direct_max)
+    if store:
+        store.record(key, "local_fft", local_fft_record(best))
+    return cfg
+
+
+def _comm_defaults(cfg):
+    """Clear comm 'auto' markers to the dataclass defaults (used when the
+    plan issues no collectives, or when every raced strategy failed)."""
+    import dataclasses as dc
+
+    from .. import params as pm
+    kw = {}
+    if cfg.comm_method == pm.AUTO:
+        kw["comm_method"] = pm.CommMethod.ALL2ALL
+    if cfg.comm_method2 == pm.AUTO:
+        kw["comm_method2"] = None
+    return dc.replace(cfg, **kw) if kw else cfg
+
+
+def _broadcast_comm_hit(folded, base):
+    """Process 0's hit/miss decision, agreed everywhere: a per-host wisdom
+    store can hit on some processes and miss on others, and a process that
+    skips the race while its peers run collective plan timings deadlocks
+    the job. Encodes ``folded`` (a Config, or None for miss) as a
+    fixed-width int vector from process 0; every process decodes the same
+    answer (None -> all race together)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from .. import params as pm
+    comms = (pm.CommMethod.ALL2ALL, pm.CommMethod.PEER2PEER)
+    sends = (pm.SendMethod.SYNC, pm.SendMethod.STREAMS, pm.SendMethod.MPI_TYPE)
+    if folded is None:
+        vec = np.full(6, -1, dtype=np.int64)
+    else:
+        vec = np.asarray([
+            1,
+            comms.index(folded.comm_method),
+            (-1 if folded.comm_method2 is None
+             else comms.index(folded.comm_method2)),
+            int(folded.opt),
+            sends.index(folded.send_method),
+            (-1 if folded.streams_chunks is None
+             else int(folded.streams_chunks)),
+        ], dtype=np.int64)
+    vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
+    if int(vec[0]) != 1:
+        return None
+    import dataclasses as dc
+    return dc.replace(
+        base,
+        comm_method=comms[int(vec[1])],
+        comm_method2=None if vec[2] < 0 else comms[int(vec[2])],
+        opt=int(vec[3]),
+        send_method=sends[int(vec[4])], send_method2=None,
+        streams_chunks=None if vec[5] < 0 else int(vec[5]))
+
+
+def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
+                  sequence, transform, dims, variant):
+    import dataclasses as dc
+
+    import jax
+
+    from .. import params as pm
+
+    single = partition.num_ranks == 1 or (kind == "batched2d"
+                                          and variant == "batch")
+    if single or dims < 2:
+        return _comm_defaults(cfg)
+    # "auto" owns the whole comm x send x opt x chunks choice (params.py
+    # contract): hits fold and winners apply onto a SYNC-normalized base,
+    # never onto an explicit send_method the race did not measure.
+    norm_base = dc.replace(_comm_defaults(cfg),
+                           send_method=pm.SendMethod.SYNC,
+                           send_method2=None, streams_chunks=None)
+    folded = None
+    rec = store.lookup(key, "comm") if store else None
+    if rec is not None:
+        try:
+            folded = _fold_comm_rec(norm_base, rec)
+        except (KeyError, TypeError, ValueError):
+            folded = None  # stale record: re-measure
+    if jax.process_count() > 1:
+        folded = _broadcast_comm_hit(folded, norm_base)
+    if folded is not None:
+        return folded
+    from ..testing import autotune as at
+    base = dc.replace(norm_base, comm_method=pm.CommMethod.ALL2ALL,
+                      comm_method2=None)
+    try:
+        ranked = at.autotune_comm(kind, global_size, partition, base,
+                                  mesh=mesh, sequence=sequence,
+                                  iterations=_COMM_ITERATIONS,
+                                  warmup=_COMM_WARMUP, dims=dims,
+                                  transform=transform, race_send=True)
+        cfg = at.apply_best_comm(ranked, norm_base)
+    except Exception:  # noqa: BLE001 — degrade to defaults, never error
+        return _comm_defaults(cfg)
+    if store:
+        store.record(key, "comm", comm_record(ranked[0], base))
+    return cfg
+
+
+def _agree_across_processes(cfg):
+    """Multi-controller runs must agree on the resolved Config: measured
+    winners are routinely within noise across processes, and divergent
+    Configs build mismatched collective programs (hang). Broadcast process
+    0's resolution as a fixed-width int vector (the same contract as
+    ``autotune_comm``'s winner broadcast)."""
+    import jax
+    if jax.process_count() <= 1:
+        return cfg
+    import dataclasses as dc
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from .. import params as pm
+    from ..ops.fft import BACKENDS
+    precs = (None, "default", "high", "highest")
+    comms = (pm.CommMethod.ALL2ALL, pm.CommMethod.PEER2PEER)
+    sends = (pm.SendMethod.SYNC, pm.SendMethod.STREAMS, pm.SendMethod.MPI_TYPE)
+    vec = np.asarray([
+        BACKENDS.index(cfg.fft_backend),
+        precs.index(cfg.mxu_precision if cfg.mxu_precision is None
+                    else str(cfg.mxu_precision).lower()),
+        -1 if cfg.mxu_direct_max is None else int(cfg.mxu_direct_max),
+        comms.index(cfg.comm_method),
+        -1 if cfg.comm_method2 is None else comms.index(cfg.comm_method2),
+        int(cfg.opt),
+        sends.index(cfg.send_method),
+        -1 if cfg.streams_chunks is None else int(cfg.streams_chunks),
+    ], dtype=np.int64)
+    vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
+    return dc.replace(
+        cfg,
+        fft_backend=BACKENDS[int(vec[0])],
+        mxu_precision=precs[int(vec[1])],
+        mxu_direct_max=None if vec[2] < 0 else int(vec[2]),
+        comm_method=comms[int(vec[3])],
+        comm_method2=None if vec[4] < 0 else comms[int(vec[4])],
+        opt=int(vec[5]),
+        send_method=sends[int(vec[6])],
+        streams_chunks=None if vec[7] < 0 else int(vec[7]))
+
+
+def resolve_config(kind: str, global_size, partition, config=None, *,
+                   mesh=None, sequence=None, transform: str = "r2c",
+                   dims: int = 3, variant: Optional[str] = None):
+    """Resolve a Config's ``fft_backend="auto"`` / ``comm_method="auto"``
+    markers into measured concrete values: wisdom hit -> reuse silently;
+    miss -> bounded race (accuracy-gated by the underlying autotuners) and
+    record; no usable store -> race without recording. Configs without an
+    'auto' marker pass through untouched — the zero-cost common case every
+    plan constructor calls."""
+    from .. import params as pm
+    cfg = config if config is not None else pm.Config()
+    wants_fft = cfg.fft_backend == pm.AUTO
+    wants_comm = pm.AUTO in (cfg.comm_method, cfg.comm_method2)
+    if not (wants_fft or wants_comm):
+        return cfg
+    store = store_for_config(cfg)
+    key = plan_key(kind, global_size.shape, cfg.double_prec, partition,
+                   cfg.norm, transform=transform, sequence=sequence,
+                   variant=variant,
+                   mesh_shape=_mesh_shape_of(mesh, partition), dims=dims)
+    if wants_fft:
+        cfg = _resolve_local_fft(cfg, store, key, kind, global_size,
+                                 partition, variant)
+    if wants_comm:
+        cfg = _resolve_comm(cfg, store, key, kind, global_size, partition,
+                            mesh, sequence, transform, dims, variant)
+    return _agree_across_processes(cfg)

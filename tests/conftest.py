@@ -14,9 +14,12 @@ import pytest
 
 import jax
 
-# Must run before any backend initialization (conftest imports precede tests).
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# Must run before any backend initialization (conftest imports precede
+# tests; importing the package does not initialize a backend). The helper
+# owns the jax<0.5 XLA_FLAGS fallback for the CPU device count.
+from distributedfft_tpu.parallel.mesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
 jax.config.update("jax_enable_x64", True)
 
 # Build the native planner once so its tests run instead of skipping on a

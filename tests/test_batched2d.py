@@ -99,7 +99,11 @@ class TestBatchChunk:
             Batched2DFFTPlan(8, 16, 16, SlabPartition(8),
                              shard="x", batch_chunk=2)
         with pytest.raises(ValueError, match="positive"):
-            Batched2DFFTPlan(8, 16, 16, SlabPartition(1), batch_chunk=0)
+            Batched2DFFTPlan(8, 16, 16, SlabPartition(1), batch_chunk=-1)
+        # 0 is the documented "whole stack fused" alias for None, not an
+        # error (the CLI/bench '0 disables chunking' convention).
+        plan = Batched2DFFTPlan(8, 16, 16, SlabPartition(1), batch_chunk=0)
+        assert plan.batch_chunk is None
 
 
 class TestHarnessWiring:

@@ -11,7 +11,15 @@ eating the whole 480 s deadline with nothing emitted; see
   — and measures the BASELINE.json metrics that don't need the real chip:
   raw all-to-all transpose bandwidth on the 8-device mesh, the pipeline's
   achieved fraction of it (the ">=70% of measured all-to-all bandwidth"
-  north-star number), and a CPU fallback roundtrip timing.
+  north-star number), the ring rendering's HLO overlap-detector counts
+  (``async_collective_ops`` in the verbose record: instance counts of
+  ``all-to-all``/``collective-permute`` and their async ``*-start`` forms
+  from ``microbench.async_collective_counts`` — ``collective_permute +
+  collective_permute_start >= P-1`` (plain + async forms summed: TPU
+  lowering rewrites each permute into a start/done pair) proves the
+  SendMethod.RING exchange stays split; starts are 0 on
+  the CPU mesh by construction and nonzero on a TPU mesh), and a CPU
+  fallback roundtrip timing.
 * Child 2 (``--child probe``) is ONE generous pre-flight TPU claim (a
   wedged claim can clear if the process waits, while every kill restarts
   the 10-15 min wedge clock — SKILL.md). It is LAUNCHED AT T=0,
@@ -564,6 +572,33 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
             t = microbench._time_fn(fn, arg, iterations=5, warmup=1)
             out["pipeline_xpose_gb_per_s"] = round(spec.nbytes / t / 1e9, 3)
 
+        # Overlap detector (ring rendering): compile the ring-assembled slab
+        # forward (SendMethod.RING, Z_Then_YX — the sequence with per-block
+        # pipelined FFTs) and report the async-collective instance counts
+        # from its HLO (microbench.async_collective_counts). Structural, not
+        # timed: collective_permute (+ its -start form on TPU, where the
+        # async lowering rewrites each permute) >= P-1 is the proof the
+        # exchange is genuinely split into distinct steps XLA cannot re-fuse (the
+        # STREAMS chunked reshards WERE re-fused — OVERLAP.md), and the
+        # *_start counts report whether this backend scheduled them
+        # asynchronously (always 0 on the CPU mesh, whose collectives lower
+        # synchronously; nonzero on a TPU mesh = measured overlap
+        # capability). Guarded: optional attribution data.
+        try:
+            rplan = dfft.SlabFFTPlan(
+                g, dfft.SlabPartition(p),
+                dfft.Config(send_method=dfft.SendMethod.RING),
+                sequence="Z_Then_YX")
+            compiled = rplan._build_r2c().lower(
+                jax.ShapeDtypeStruct(rplan.input_padded_shape,
+                                     np.float32)).compile()
+            out["async_collective_ops"] = \
+                microbench.async_collective_counts(compiled)
+        except TimeoutError:
+            raise
+        except Exception as e:  # noqa: BLE001 — optional attribution data
+            out["async_collective_error"] = f"{type(e).__name__}: {e}"
+
         # Geometry attribution matrix (reference testcases 1-3: 1D/2D/3D-memcpy
         # probes, tests_reference.hpp:53-96): exchange bandwidth per geometry x
         # strategy, with the collectives found in the compiled HLO as evidence.
@@ -1033,6 +1068,15 @@ def main() -> int:
                 mesh["alltoall_fraction_variant"]
             result["alltoall_fraction_variants"] = \
                 mesh.get("alltoall_fraction_variants")
+        if mesh.get("async_collective_ops"):
+            # Overlap-detector counts of the ring-assembled plan's HLO
+            # (microbench.async_collective_counts): collective_permute +
+            # collective_permute_start >= P-1 (the async lowering on TPU
+            # rewrites each permute into a start/done pair) proves the ring
+            # exchange is genuinely split; the *_start
+            # counts report async scheduling (0 on the CPU mesh by
+            # construction, nonzero on TPU = measured overlap capability).
+            result["async_collective_ops"] = mesh["async_collective_ops"]
         if mesh.get("geometry_gb_per_s"):
             result["geometry_gb_per_s"] = mesh["geometry_gb_per_s"]
         if mesh.get("mesh_pipeline_sequences"):

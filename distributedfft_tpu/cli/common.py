@@ -98,6 +98,9 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
         ap.add_argument("--send-method1", "-snd1", default="Sync",
                         help="Sync (monolithic exchange) | Streams (chunked/"
                              "pipelined transpose, see --streams-chunks) | "
+                             "Ring (ppermute-ring exchange with per-block "
+                             "FFTs pipelined between steps; owns the "
+                             "rendering regardless of comm method) | "
                              "MPI_Type (alias of Sync)")
         ap.add_argument("--comm-method2", "-comm2", default=None,
                         help="same as --comm-method1 for transpose 2")
@@ -110,6 +113,9 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
         ap.add_argument("--send-method", "-snd", default="Sync",
                         help="Sync (monolithic exchange) | Streams (chunked/"
                              "pipelined transpose, see --streams-chunks) | "
+                             "Ring (ppermute-ring exchange with per-block "
+                             "FFTs pipelined between steps; owns the "
+                             "rendering regardless of comm method) | "
                              "MPI_Type (alias of Sync)")
     ap.add_argument("--streams-chunks", type=int, default=None,
                     help="piece count for the Streams pipelined transpose "

@@ -34,7 +34,7 @@ from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
-                                  pad_axis_to, slice_axis_to,
+                                  pad_axis_to, ring_transpose, slice_axis_to,
                                   split_axis_chunks)
 from ..utils import wisdom
 from .base import _with_pad, jit_stages
@@ -339,13 +339,29 @@ class Batched2DFFTPlan:
         ``SendMethod.STREAMS`` chunks along the batch axis (the one axis
         the 2D transform and the transpose both leave untouched) into K
         independent exchange->FFT piece chains, exactly like the slab
-        engine's pipelined rendering."""
+        engine's pipelined rendering.
+
+        ``SendMethod.RING`` renders the exchange as the ``P-1``-step
+        ``lax.ppermute`` ring (``ring_transpose``) — owning the rendering
+        regardless of ``comm_method``, the slab contract. The
+        post-transpose FFT runs along the gathered axis, so no per-block
+        compute is pipelined; ``last`` runs on the assembled block."""
         first, xpose, last = self._slab_parts(forward)
         mesh = self.mesh
         if forward:
             in_spec, out_spec = self._in_spec, self._out_spec
         else:
             in_spec, out_spec = self._out_spec, self._in_spec
+        if self.config.send_method is pm.SendMethod.RING:
+            split, concat = (2, 1) if forward else (1, 2)
+
+            def rbody(v):
+                return last(ring_transpose(first(v), SLAB_AXIS, split,
+                                           concat))
+
+            return (jax.shard_map(rbody, mesh=mesh, in_specs=in_spec,
+                                  out_specs=out_spec),
+                    in_spec, out_spec)
         streams = self.config.send_method is pm.SendMethod.STREAMS
         k = self.config.resolved_streams_chunks()
         if self.config.comm_method is pm.CommMethod.ALL2ALL:

@@ -54,7 +54,7 @@ from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
-                                  pad_axis_to, slice_axis_to,
+                                  pad_axis_to, ring_transpose, slice_axis_to,
                                   split_axis_chunks)
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
@@ -398,12 +398,14 @@ class PencilFFTPlan(DistFFTPlan):
         if dims >= 2:
             if not self._attach(segments, self.config.comm_method,
                                 self.config.send_method, t1, s2,
-                                self._mid_spec, ca=0):
+                                self._mid_spec, ca=0,
+                                xinfo=(P2_AXIS, 2, 1)):
                 segments.append((s2, self._mid_spec))
         if dims >= 3:
             if not self._attach(segments, self.config.resolved_comm2(),
                                 self.config.resolved_snd2(), t2, s3,
-                                self._out_spec, ca=2):
+                                self._out_spec, ca=2,
+                                xinfo=(P1_AXIS, 1, 0)):
                 segments.append((s3, self._out_spec))
         return segments, self._in_spec
 
@@ -416,14 +418,16 @@ class PencilFFTPlan(DistFFTPlan):
             segments.append((i3, self._out_spec))
             if self._attach(segments, self.config.resolved_comm2(),
                             self.config.resolved_snd2(), t2b, i2,
-                            self._mid_spec, ca=2):
+                            self._mid_spec, ca=2,
+                            xinfo=(P1_AXIS, 0, 1)):
                 i2 = None  # consumed into the chunked segment
         if dims >= 2:
             if i2 is not None:
                 segments.append((i2, self._mid_spec))
             if self._attach(segments, self.config.comm_method,
                             self.config.send_method, t1b, i1,
-                            self._in_spec, ca=0):
+                            self._in_spec, ca=0,
+                            xinfo=(P2_AXIS, 1, 2)):
                 i1 = None
         if i1 is not None:
             segments.append((i1, self._in_spec))
@@ -538,7 +542,8 @@ class PencilFFTPlan(DistFFTPlan):
 
 
     def _attach(self, segments, comm: pm.CommMethod, snd: pm.SendMethod,
-                a2a, nxt, spec_after, ca: int) -> bool:
+                a2a, nxt, spec_after, ca: int, *,
+                xinfo: Tuple[str, int, int]) -> bool:
         """Attach a transpose to the segment list.
 
         ALL2ALL + SYNC: explicit collective fused into the previous segment.
@@ -553,7 +558,26 @@ class PencilFFTPlan(DistFFTPlan):
         re-fuses the pieces into one collective (see
         ``SlabFFTPlan._assemble_pure``), so this is equivalent to SYNC;
         ALL2ALL is the genuinely chunked rendering.
+        RING (any comm): the transpose rendered as the ``P-1``-step
+        ``lax.ppermute`` ring (``ring_transpose`` over ``xinfo =
+        (axis_name, split, concat)``), fused into the previous segment — a
+        ring is only expressible inside shard_map, so RING owns the
+        rendering regardless of ``comm``. Every pencil post-transpose FFT
+        runs along the gathered axis (the received blocks are disjoint
+        slices of exactly that axis), so no per-block compute is pipelined
+        here; the win is the ``P-1`` distinct, independently schedulable
+        collective-permutes GSPMD cannot re-fuse the way it re-fuses the
+        chunked reshards.
         """
+        if snd is pm.SendMethod.RING:
+            prev_fn, _ = segments[-1]
+            axis_name, split, concat = xinfo
+
+            def rseg(c, f=prev_fn):
+                return ring_transpose(f(c), axis_name, split, concat)
+
+            segments[-1] = (rseg, spec_after)
+            return False
         streams = snd is pm.SendMethod.STREAMS
         if comm is pm.CommMethod.ALL2ALL:
             prev_fn, _ = segments[-1]

@@ -61,7 +61,7 @@ from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
-                                  pad_axis_to, slice_axis_to,
+                                  pad_axis_to, ring_transpose, slice_axis_to,
                                   split_axis_chunks)
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
@@ -435,6 +435,94 @@ class SlabFFTPlan(DistFFTPlan):
 
         return body
 
+    # -- RING (ppermute-pipelined) bodies ----------------------------------
+    # SendMethod.RING decomposes each transpose into P-1 DISTINCT
+    # ``lax.ppermute`` steps (``parallel/transpose.ring_transpose``) and
+    # runs the post-transpose FFT stages that do not touch the gathered
+    # axis on each peer block AS IT ARRIVES — receiver-side pipelining,
+    # the TPU analog of the reference Streams engine's per-peer
+    # MPI_Isend/compute interleave. Unlike the STREAMS chunked collectives
+    # (which GSPMD re-fuses — OVERLAP.md), the P-1 permutes carry
+    # different data and cannot be merged, so the scheduler can genuinely
+    # hide step t+1's wire time behind block t's FFT. The gathered-axis
+    # FFT (always axis 0 on the slab forward) needs the assembled block
+    # and runs after the ring drains, as does the shape-changing C2R
+    # half-axis inverse.
+
+    def _ring_pipe(self, axes, inverse: bool = False):
+        """Shape-preserving per-block FFT pipeline over ``axes`` (None when
+        empty — ring_transpose then skips the per-block stage)."""
+        if not axes:
+            return None
+        norm, be, st = self.config.norm, self.config.fft_backend, self._mxu_st
+        tf = lf.ifft if inverse else lf.fft
+
+        def pipe(b):
+            for a in axes:
+                b = tf(b, axis=a, norm=norm, backend=be, settings=st)
+            return b
+
+        return pipe
+
+    def _ring_fwd_body(self):
+        """Local forward body for SendMethod.RING: first-stage FFTs, then
+        the ring-decomposed exchange with the non-gathered post-axis FFTs
+        pipelined per arriving peer block (Z_Then_YX's y axis, Y_Then_ZX's
+        z axis; ZY_Then_X's only post axis is the gathered x), then the
+        gathered-axis FFT on the assembled block."""
+        s, norm, g = self._seq, self.config.norm, self.global_size
+        be, st = self.config.fft_backend, self._mxu_st
+        first = self._fwd_parts()[0]
+        pipe = self._ring_pipe(tuple(a for a in s.post_axes if a != 0))
+        after = tuple(a for a in s.post_axes if a == 0)
+        sa, nx = s.split_axis, g.nx
+
+        def body(xl):
+            y = ring_transpose(first(xl), SLAB_AXIS, sa, 0, pipeline_fn=pipe)
+            y = slice_axis_to(y, 0, nx)
+            for a in after:
+                y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
+            return y
+
+        return body
+
+    def _ring_inv_body(self):
+        """Mirror of ``_ring_fwd_body``: the inverse exchange gathers the
+        split axis, so the pipelined set is the last-stage C2C axes other
+        than it (the C2C r2c-axis inverse where it is not the split axis);
+        the shape-changing C2R transform always waits for assembly. Note
+        the one rounding consequence in this PR: pipelining hoists that
+        C2C r2c-axis IFFT ahead of the split-axis IFFT, so the c2c inverse
+        agrees with the SYNC rendering to ~1e-15 RELATIVE rather than to
+        the bit (every other path — bare ring, all forwards, r2c inverses
+        — is bit-identical; tests/test_ring.py pins both levels)."""
+        s, norm, g = self._seq, self.config.norm, self.global_size
+        be, st = self.config.fft_backend, self._mxu_st
+        first = self._inv_parts()[0]
+        sa, split_ext = s.split_axis, self._split_ext
+        real_n = g.nz if s.halved == "z" else g.ny
+        complex_mode = self.transform == "c2c"
+        pipe_axes = tuple(a for a in reversed(s.pre_axes) if a != sa)
+        if complex_mode and s.r2c_axis != sa:
+            pipe_axes = pipe_axes + (s.r2c_axis,)
+        pipe = self._ring_pipe(pipe_axes, inverse=True)
+        after = tuple(a for a in reversed(s.pre_axes) if a == sa)
+
+        def body(cl):
+            y = ring_transpose(first(cl), SLAB_AXIS, 0, sa, pipeline_fn=pipe)
+            y = slice_axis_to(y, sa, split_ext)
+            for a in after:
+                y = lf.ifft(y, axis=a, norm=norm, backend=be, settings=st)
+            if complex_mode:
+                if s.r2c_axis == sa:
+                    y = lf.ifft(y, axis=s.r2c_axis, norm=norm, backend=be,
+                                settings=st)
+                return y
+            return lf.irfft(y, n=real_n, axis=s.r2c_axis, norm=norm,
+                            backend=be, settings=st)
+
+        return body
+
     # -- pipeline builders -------------------------------------------------
 
     def _build_r2c(self):
@@ -483,9 +571,19 @@ class SlabFFTPlan(DistFFTPlan):
         slice-of-reshard and CSEs the shared exchange). Under GSPMD
         delegation a chunked exchange cannot be forced; the explicit
         ALL2ALL rendering is the real chunked path, so a P2P+STREAMS
-        config is an honest no-op rather than a mismeasured variant."""
+        config is an honest no-op rather than a mismeasured variant.
+
+        ``SendMethod.RING`` renders the exchange as the ``P-1``-step
+        ``lax.ppermute`` ring (``_ring_fwd_body``/``_ring_inv_body``). A
+        ring is only expressible as an explicit shard_map program, so RING
+        owns the rendering regardless of ``comm`` (params.py contract:
+        GSPMD delegation has no ppermute analog)."""
         first, xpose, last = parts
         mesh = self.mesh
+        if self.config.send_method is pm.SendMethod.RING:
+            body = self._ring_fwd_body() if forward else self._ring_inv_body()
+            return jax.shard_map(body, mesh=mesh, in_specs=in_spec,
+                                 out_specs=out_spec)
         streams = self.config.send_method is pm.SendMethod.STREAMS
         if comm is pm.CommMethod.ALL2ALL:
             if streams:

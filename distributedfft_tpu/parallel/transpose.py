@@ -84,11 +84,19 @@ def concat_axis_chunks(pieces, axis: int):
 def chunked_reshard(x, target, axis: int, k: int):
     """Reshard the global array ``x`` to ``target`` (a NamedSharding) as
     ``k`` independent piece-reshards along ``axis`` — the PEER2PEER
-    rendering of ``SendMethod.STREAMS``: GSPMD emits one smaller
-    collective per piece instead of one monolithic redistribution,
-    handing its scheduler K independently schedulable exchanges (the TPU
-    counterpart of the reference Streams engine's per-peer sends,
-    ``src/slab/default/mpicufft_slab.cpp:343-448``).
+    rendering of ``SendMethod.STREAMS``, intended as the TPU counterpart
+    of the reference Streams engine's per-peer sends
+    (``src/slab/default/mpicufft_slab.cpp:343-448``).
+
+    MEASURED NEGATIVE RESULT (8-device CPU mesh, k=4 — see
+    ``eval/benchmarks/cpumesh8/OVERLAP.md``): GSPMD re-fuses the K piece
+    reshards into ONE collective — the compiled HLO is identical to the
+    monolithic SYNC exchange, with ZERO async collective ops — so this
+    rendering buys no pipelining; it is kept as the honest P2P+STREAMS
+    no-op. For real comm/compute overlap use ``ring_transpose``
+    (``SendMethod.RING``): its ``P-1`` distinct ``collective-permute``
+    steps cannot be re-fused, and the overlap detector
+    (``microbench.async_collective_counts``) fires on them.
 
     ``axis`` must be an axis whose sharding the stage boundary does NOT
     change (the exchange's free axis). When it is unsharded (slab free
@@ -129,6 +137,81 @@ def chunked_reshard(x, target, axis: int, k: int):
     pieces = [jax.lax.with_sharding_constraint(p, rs_target)
               for p in split_axis_chunks(y, axis + 1, k)]
     return jnp.reshape(concat_axis_chunks(pieces, axis + 1), x.shape)
+
+
+def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
+                   pipeline_fn=None):
+    """Ring-pipelined rendering of the tiled ``lax.all_to_all`` exchange:
+    the global transpose decomposed into ``P-1`` ``lax.ppermute`` steps
+    (rotation offset t sends the block destined for peer ``r+t`` directly,
+    so the total wire bytes equal the monolithic collective's), plus the
+    zero-cost local block. Logical result is bit-identical to
+    ``lax.all_to_all(..., split_axis, concat_axis, tiled=True)``.
+
+    Why this rendering exists: the chunked STREAMS piece-reshards are
+    re-fused by GSPMD into one collective (measured —
+    ``eval/benchmarks/cpumesh8/OVERLAP.md``), and even the explicit chunked
+    ``all_to_all``s stay K instances of the same op. Each ring step here is
+    a DISTINCT ``collective-permute`` (async ``collective-permute-start``/
+    ``done`` pair on TPU) carrying different data, which XLA can neither
+    CSE nor re-fuse — so the exchange is genuinely split into ``P-1``
+    independently schedulable transfers, the TPU analog of the reference
+    Streams engine's per-peer ``MPI_Isend`` loop
+    (``src/slab/default/mpicufft_slab.cpp:343-448``).
+
+    ``pipeline_fn`` (optional) runs on each peer block AS IT ARRIVES —
+    traced between ring steps, so step t+1's permute (whose operand is
+    ready before the ring starts) can be in flight while block t computes;
+    by the time the ring drains, all but the last block are already
+    processed. It must be shape/dtype-preserving and must not mix data
+    across ``concat_axis`` positions (received blocks are disjoint slices
+    of the output along that axis) — per-axis FFTs along any axis other
+    than ``concat_axis`` qualify; the gathered-axis FFT must wait for
+    assembly.
+
+    The ``split_axis`` extent must be divisible by the mesh axis size
+    (plans pad). Must be called inside ``shard_map`` over ``axis_name``.
+    """
+    p = _axis_size(axis_name)
+    if pipeline_fn is None:
+        def pipeline_fn(b):
+            return b
+    if p == 1:
+        return pipeline_fn(x)
+    s, c = split_axis, concat_axis
+    ext = x.shape[s]
+    if ext % p:
+        raise ValueError(
+            f"ring transpose needs split extent {ext} divisible by the "
+            f"mesh axis size {p} (plans pad before the exchange)")
+    ch = ext // p
+    r = lax.axis_index(axis_name)
+
+    def chunk(i):
+        # Block destined for peer (r + i) mod p: a traced-offset slice, so
+        # every device runs the same program on its own rotation.
+        return lax.dynamic_slice_in_dim(x, ((r + i) % p) * ch, ch, axis=s)
+
+    # Step 0 is the local block (peer r -> itself, no wire). Step t sends
+    # chunk r+t to peer r+t and receives peer (r-t)'s block for us; the
+    # received block is pipelined immediately, before step t+1's permute
+    # result is consumed.
+    blocks = [pipeline_fn(chunk(0))]
+    for t in range(1, p):
+        perm = [(src, (src + t) % p) for src in range(p)]
+        blocks.append(pipeline_fn(lax.ppermute(chunk(t), axis_name, perm)))
+    # Reassemble in PEER order along the concat axis (tiled all_to_all
+    # semantics: the block from peer j lands at concat slot j). Block t
+    # came from peer (r - t) mod p, so peer order is the arrival order
+    # reversed then rotated by r+1: with V = flip(W), V[(j-r-1) mod p] =
+    # W[(r-j) mod p] — i.e. roll(V, r+1)[j] is peer j's block.
+    w = jnp.stack(blocks, axis=0)
+    o = jnp.roll(jnp.flip(w, axis=0), r + 1, axis=0)
+    o = jnp.moveaxis(o, 0, c)
+    shp = list(o.shape)
+    merged = shp.pop(c)
+    shp[c] *= merged
+    return o.reshape(tuple(shp))
 
 
 def realigned_pack_shape(shape, split_axis: int, p: int):

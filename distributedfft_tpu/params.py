@@ -18,11 +18,19 @@ On TPU the comm/send matrix collapses into *how the XLA program is built*:
 * ``SendMethod.STREAMS`` -> the chunked/software-pipelined transpose: the
   local block is split into ``Config.streams_chunks`` pieces along an axis
   untouched by the exchange, and each piece runs its own
-  FFT -> collective -> FFT chain. The chains are data-independent, so XLA's
-  async collectives (``all-to-all-start/done`` on TPU) can overlap piece
-  i's exchange with piece i-1's compute — the role of the reference's
+  FFT -> collective -> FFT chain — the intended role of the reference's
   Streams engine (per-peer packs on CUDA streams + callback thread +
   ``MPI_Isend``, ``src/slab/default/mpicufft_slab.cpp:343-448``).
+  MEASURED RESULT (``eval/benchmarks/cpumesh8/OVERLAP.md``): under
+  PEER2PEER, GSPMD re-fuses the piece reshards into ONE collective
+  (HLO identical to SYNC), and even the explicit ALL2ALL rendering's K
+  chunked collectives showed ZERO async collective ops — its measured
+  1.2-1.4x win is a working-set effect, not overlap.
+* ``SendMethod.RING`` -> the ring-pipelined transpose
+  (``parallel/transpose.ring_transpose``): ``P-1`` distinct
+  ``lax.ppermute`` steps XLA cannot re-fuse, with per-peer-block FFT
+  compute pipelined between them — the overlap-capable rendering the
+  STREAMS result motivated.
   ``SYNC`` is the monolithic single-collective pipeline; ``MPI_TYPE``
   (zero-copy strided datatypes) has no analog under XLA -- packing is a
   fused transpose -- and is accepted as a benchmarking label alias of SYNC.
@@ -82,11 +90,26 @@ class CommMethod(enum.Enum):
 class SendMethod(enum.Enum):
     """Packing strategy (reference ``params.hpp:87-89``). ``STREAMS``
     selects the chunked/software-pipelined transpose (see module
-    docstring); ``SYNC``/``MPI_TYPE`` are the monolithic pipeline."""
+    docstring); ``SYNC``/``MPI_TYPE`` are the monolithic pipeline.
+
+    ``RING`` is an extension beyond the reference's 2x3 matrix: the
+    transpose decomposed into ``P-1`` ``lax.ppermute`` ring steps
+    (``parallel/transpose.ring_transpose``), one peer block per step,
+    with the per-block post-transpose FFT stage pipelined between steps
+    where the axis roles allow. Unlike STREAMS' chunked collectives —
+    which GSPMD re-fuses under PEER2PEER and which stay K instances of
+    one op under ALL2ALL — each ring step is a distinct
+    ``collective-permute`` (async start/done pair on TPU) that XLA cannot
+    re-fuse, so this is the rendering on which the overlap detector
+    (HLO async-collective counts) actually fires. A ring is only
+    expressible as an explicit ``shard_map`` program, so RING owns the
+    exchange rendering regardless of ``comm_method`` (GSPMD delegation
+    has no ppermute analog)."""
 
     SYNC = "Sync"
     STREAMS = "Streams"
     MPI_TYPE = "MPI_Type"
+    RING = "Ring"
 
     @classmethod
     def parse(cls, s: "str | SendMethod") -> "SendMethod":
@@ -97,6 +120,8 @@ class SendMethod(enum.Enum):
             return cls.SYNC
         if key == "streams":
             return cls.STREAMS
+        if key == "ring":
+            return cls.RING
         if key in ("mpitype", "mpit", "type"):
             return cls.MPI_TYPE
         raise ValueError(f"unknown send method: {s!r}")
@@ -294,8 +319,8 @@ class Config:
     (``utils/wisdom.py``; path from ``wisdom_path`` -> ``$DFFT_WISDOM``),
     races the backends on a miss and records the winner. ``comm_method=
     "auto"`` does the same for the whole comm x send x opt x streams-chunks
-    variant (ignoring the explicit ``send_method``/``opt`` fields — the
-    race owns them). ``use_wisdom=False`` (CLI ``--no-wisdom``) never
+    variant, the RING ring rendering included (ignoring the explicit
+    ``send_method``/``opt`` fields — the race owns them). ``use_wisdom=False`` (CLI ``--no-wisdom``) never
     touches disk; "auto" then races per process.
 
     ``streams_chunks`` sets how many pieces the ``SendMethod.STREAMS``

@@ -186,13 +186,13 @@ class CommCandidate:
     comparative dimension, ``include/mpicufft_slab.hpp:145-158``): global-
     redistribution strategy per transpose x data-layout opt, optionally
     crossed with the send-method axis (``send``/``chunks``: the STREAMS
-    chunked-pipelined transpose at a given piece count; ``send=None`` keeps
-    the base config's monolithic SYNC exchange — the reference's
-    ``-snd``/``-snd2`` dimension)."""
+    chunked-pipelined transpose at a given piece count, or the RING
+    ppermute rendering; ``send=None`` keeps the base config's monolithic
+    SYNC exchange — the reference's ``-snd``/``-snd2`` dimension)."""
     comm: object                 # CommMethod for transpose 1
     comm2: Optional[object]      # pencil transpose 2 (None for slab)
     opt: int
-    send: object = None          # SendMethod.STREAMS variants only
+    send: object = None          # SendMethod.STREAMS/RING variants only
     chunks: Optional[int] = None  # streams_chunks for send=STREAMS
     fwd_ms: float = float("nan")
     inv_ms: float = float("nan")
@@ -209,7 +209,8 @@ class CommCandidate:
         tag = c1 if self.comm2 is None else f"{c1}+{self.comm2.value}"
         tag = f"{tag}/opt{self.opt}"
         if self.send is not None:
-            tag += f"/streams{self.chunks}"
+            tag += ("/ring" if getattr(self.send, "name", None) == "RING"
+                    else f"/streams{self.chunks}")
         return tag
 
 
@@ -244,9 +245,14 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
 
     ``race_send=True`` adds the send-method axis: each ALL2ALL point also
     races the STREAMS chunked-pipelined transpose at every piece count in
-    ``streams_chunks`` (the reference's ``-snd`` dimension). PEER2PEER
-    points are not crossed — GSPMD re-fuses piece reshards into one
-    collective (measured, ``models/slab._assemble_pure``), so a
+    ``streams_chunks`` (the reference's ``-snd`` dimension), plus ONE
+    ``SendMethod.RING`` candidate (the ppermute ring rendering,
+    ``parallel/transpose.ring_transpose``). The ring owns the exchange
+    rendering regardless of comm_method and ignores the opt layout axis
+    (both are properties of the ``lax.all_to_all`` it replaces), so it
+    races once — under the first opt's ALL2ALL point — not per cell.
+    PEER2PEER points are not crossed — GSPMD re-fuses piece reshards into
+    one collective (measured, ``models/slab._assemble_pure``), so a
     P2P+STREAMS candidate would mismeasure a program identical to SYNC.
 
     Returns candidates sorted by measured forward+inverse time; apply the
@@ -275,6 +281,12 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
                                             send=SendMethod.STREAMS,
                                             chunks=int(k))
                               for k in streams_chunks if k and int(k) > 1]
+                    if opt == opts[0]:
+                        # Ring is opt- and comm-agnostic (it replaces the
+                        # all_to_all those knobs parameterize): one
+                        # candidate, not a duplicate per matrix cell.
+                        cands.append(CommCandidate(cc1, cc2, opt,
+                                                   send=SendMethod.RING))
 
     rdt = np.float64 if base.double_prec else np.float32
     xs = np.random.default_rng(seed).random(

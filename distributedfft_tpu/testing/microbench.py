@@ -41,6 +41,34 @@ def _time_fn(fn, x, iterations: int, warmup: int) -> float:
 _COLLECTIVE_OPS = ("all-to-all", "collective-permute", "all-gather",
                    "reduce-scatter", "all-reduce")
 
+# Exchange collectives and their async start forms, as (json key, HLO op
+# mnemonic) pairs. Counted as op INSTANCES — "<op>(" with the opening
+# paren — so "all-to-all(" does not match the async "all-to-all-start("
+# form and vice versa.
+_ASYNC_HLO_FORMS = (("all_to_all", "all-to-all"),
+                    ("all_to_all_start", "all-to-all-start"),
+                    ("collective_permute", "collective-permute"),
+                    ("collective_permute_start", "collective-permute-start"))
+
+
+def async_collective_counts(hlo) -> Dict[str, int]:
+    """Instance counts of the exchange collectives (and their async start
+    forms) in a compiled module — the overlap detector the STREAMS negative
+    result designated (``eval/benchmarks/cpumesh8/OVERLAP.md``). GSPMD can
+    re-fuse K chunked piece-reshards into ONE collective (measured), but it
+    cannot merge the ``P-1`` DISTINCT ``collective-permute`` steps of the
+    ring rendering (``SendMethod.RING``): ``collective_permute >= P-1`` is
+    the structural signature that the exchange is genuinely split, and
+    nonzero ``*_start`` counts are the evidence the backend scheduled the
+    transfers asynchronously (TPU emits start/done pairs; the CPU backend
+    lowers every collective synchronously, so its ``async_total`` is 0 by
+    construction). Accepts a compiled executable or raw HLO text."""
+    txt = hlo if isinstance(hlo, str) else hlo.as_text()
+    out = {name: txt.count(f" {op}(") for name, op in _ASYNC_HLO_FORMS}
+    out["async_total"] = (out["all_to_all_start"]
+                          + out["collective_permute_start"])
+    return out
+
 
 def _collectives_in(compiled) -> list:
     """Collective op names present in the compiled HLO — evidence that a
@@ -85,23 +113,30 @@ def wire_probe(shape, p: int, dtype=np.float32):
 def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
                  repeats: int = 5, iterations: int = 3, warmup: int = 1,
                  backend: str = "xla", sequence: str = "ZY_Then_X",
-                 comm: str = "All2All", opt: int = 1) -> Dict:
+                 comm: str = "All2All", opt: int = 1,
+                 include_ring: bool = True) -> Dict:
     """Race the monolithic slab pipeline (``SendMethod.SYNC`` — one
     collective per transpose) against the STREAMS chunked/software-pipelined
-    rendering (K independent per-piece FFT->exchange->FFT chains), measuring
-    whether splitting the exchange buys compute/communication overlap — the
-    question the reference answers with its Streams engine
+    rendering (K independent per-piece FFT->exchange->FFT chains) and the
+    RING ppermute rendering (``include_ring``; P-1 distinct
+    collective-permute steps with per-block FFTs pipelined between them),
+    measuring whether splitting the exchange buys compute/communication
+    overlap — the question the reference answers with its Streams engine
     (``src/slab/default/mpicufft_slab.cpp:343-448``) and SURVEY §7 says to
     measure, not assume.
 
     Each variant times a K-chained forward+inverse roundtrip via the
     ``(t_K - t_1)/(K-1)`` pair difference (chaintimer contract), all within
     the same repeat so drift hits every variant equally. The result also
-    carries per-variant HLO attribution: counts of ``all-to-all`` ops and of
-    async ``all-to-all-start`` forms in the compiled module — on a backend
-    whose collectives lower synchronously (CPU) the chunked variant CANNOT
-    overlap, and the counts say so; async starts are the evidence that the
-    scheduler may hide exchange latency behind the neighbouring FFTs.
+    carries per-variant HLO attribution (``async_collective_counts``):
+    instance counts of ``all-to-all``/``collective-permute`` ops and their
+    async ``*-start`` forms in the compiled module — on a backend whose
+    collectives lower synchronously (CPU) no variant CAN overlap, and the
+    counts say so; async starts are the evidence that the scheduler may
+    hide exchange latency behind the neighbouring FFTs. The STREAMS
+    chunked collectives were measured to stay fused/synchronous (zero
+    async starts — the OVERLAP.md negative result); the ring's distinct
+    permutes are the rendering that can fire the detector.
     """
     import jax.lax as lax
 
@@ -114,11 +149,15 @@ def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
     g = pm.GlobalSize(*global_shape)
     scale = 1.0 / float(g.n_total)
     variants = [("sync", None)] + [(f"streams{c}", c) for c in chunk_counts]
+    if include_ring:
+        variants.append(("ring", None))
     fns, hlo = {}, {}
     for name, chunks in variants:
+        snd = (pm.SendMethod.RING if name == "ring"
+               else pm.SendMethod.SYNC if chunks is None
+               else pm.SendMethod.STREAMS)
         cfg = pm.Config(comm_method=pm.CommMethod.parse(comm),
-                        send_method=(pm.SendMethod.SYNC if chunks is None
-                                     else pm.SendMethod.STREAMS),
+                        send_method=snd,
                         streams_chunks=chunks, fft_backend=backend, opt=opt)
         plan = SlabFFTPlan(g, pm.SlabPartition(p), cfg, sequence=sequence)
         fwd, inv = plan.forward_fn(), plan.inverse_fn()
@@ -135,11 +174,7 @@ def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
                 plan.input_padded_shape).astype(np.float32), ishard)
         f1, fK = chain(1), chain(k)
         compiled = f1.lower(x).compile()
-        txt = compiled.as_text()
-        # Op INSTANCES (`<op>(` with the opening paren), not substring hits:
-        # "all-to-all(" does not match the async "all-to-all-start(" form.
-        hlo[name] = {"all_to_all": txt.count(" all-to-all("),
-                     "all_to_all_start": txt.count(" all-to-all-start(")}
+        hlo[name] = async_collective_counts(compiled)
         jax.block_until_ready(fK(x))  # compile + warm the K-chain too
         fns[name] = (f1, fK, x)
 

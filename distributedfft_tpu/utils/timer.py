@@ -33,7 +33,11 @@ from ..params import CommMethod, Config, GlobalSize, SendMethod
 from . import native_planner
 
 _COMM_CODE = {CommMethod.PEER2PEER: 0, CommMethod.ALL2ALL: 1}
-_SEND_CODE = {SendMethod.SYNC: 0, SendMethod.STREAMS: 1, SendMethod.MPI_TYPE: 2}
+# 0-2 are the reference's own send codes (params.hpp:87-89); 3 extends the
+# filename schema for the RING rendering, which has no reference analog —
+# eval reduction keys on the literal code, so new codes only add rows.
+_SEND_CODE = {SendMethod.SYNC: 0, SendMethod.STREAMS: 1, SendMethod.MPI_TYPE: 2,
+              SendMethod.RING: 3}
 
 
 def benchmark_filename(benchmark_dir: str, variant: str, config: Config,

@@ -8,7 +8,8 @@ the same amortization for THIS framework's two measured plan choices:
   a measured 3.3x spread between backends on v5e, see its docstring), and
 * the comm-variant race (``testing/autotune.autotune_comm`` with
   ``race_send=True`` — comm_method x send_method x opt x streams-chunks,
-  the reference's primary comparative dimension).
+  the reference's primary comparative dimension, plus the RING
+  ppermute-ring rendering added in store version 2).
 
 The reference pays its tuning once per plan (``cufftMakePlanMany64`` picks
 kernels at plan creation); our port previously re-raced on every process
@@ -20,7 +21,7 @@ zero measurement time.
 
 Store format: ONE JSON file::
 
-    {"version": 1,
+    {"version": 2,
      "entries": {"<canonical key json>": {"local_fft": {...}, "comm": {...}}}}
 
 Keys fold in everything that can change a winner: platform, device kind,
@@ -28,16 +29,26 @@ jax version, global shape, dtype, mesh shape, decomposition (kind +
 partition grid + sequence/variant + transform), and norm. A key built on a
 different mesh, dtype or jax version simply misses.
 
+Version 2 added the RING (ppermute-ring) rendering to the comm race.
+Version-1 stores MIGRATE rather than error: their ``local_fft`` records
+are variant-agnostic and carry over verbatim, while their ``comm`` records
+were winners of a race that never saw the ring variant and therefore read
+as misses (re-raced once, re-recorded under v2). Any later/unknown version
+reads as empty.
+
 Degradation contract: a missing, corrupt, partially-valid or
 version-mismatched store reads as EMPTY (re-measure); a record whose fields
 no longer validate (e.g. a backend this build doesn't know) is a miss; a
 failed write is swallowed after a best-effort retry. Wisdom can cost a
 redundant measurement, never an error. Writes are atomic (tmp +
-``os.replace``) and merge from a fresh read of the on-disk entries, so a
-reader never sees a torn file — but the read-merge-replace window is not
-locked, so of two processes recording concurrently one update can be lost
-(and is simply re-measured by a later miss; wisdom loses measurements,
-never correctness).
+``os.replace``), merge from a fresh read of the on-disk entries, and the
+read-merge-replace window is serialized across processes by a best-effort
+advisory lock on ``<path>.lock`` (``fcntl.flock``) — so N concurrent
+recorders sharing one ``$DFFT_WISDOM`` cannot interleave into a corrupt
+store or lose each other's updates. Where flock is unavailable the write
+stays atomic but unlocked: a concurrent update can then be lost (and is
+simply re-measured by a later miss; wisdom loses measurements, never
+correctness).
 
 The store path resolves as ``Config.wisdom_path`` -> ``$DFFT_WISDOM`` ->
 disabled. ``Config(use_wisdom=False)`` (CLI ``--no-wisdom``) never touches
@@ -46,12 +57,13 @@ disk; "auto" then races per process like before wisdom existed.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import tempfile
 from typing import Any, Dict, Optional, Sequence, Tuple
 
-WISDOM_VERSION = 1
+WISDOM_VERSION = 2
 ENV_VAR = "DFFT_WISDOM"
 
 # Bounded construction-time race defaults. The local chain length is the
@@ -94,8 +106,37 @@ def store_for_config(config) -> Optional["WisdomStore"]:
                       getattr(config, "use_wisdom", True))
 
 
+@contextlib.contextmanager
+def _advisory_lock(path: str):
+    """Best-effort exclusive ``fcntl.flock`` on ``path + '.lock'``,
+    serializing the read-merge-replace window across processes sharing one
+    store. Degrades to unlocked on platforms/filesystems without flock:
+    the write itself stays atomic (tmp + ``os.replace``), so concurrency
+    can then lose an update, never corrupt the file."""
+    lock = None
+    try:
+        try:
+            import fcntl
+            lock = open(path + ".lock", "a")
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            if lock is not None:
+                lock.close()
+            lock = None
+        yield
+    finally:
+        if lock is not None:
+            try:
+                import fcntl
+                fcntl.flock(lock, fcntl.LOCK_UN)
+            except (ImportError, OSError, ValueError):
+                pass
+            lock.close()
+
+
 class WisdomStore:
-    """One JSON wisdom file; every read is tolerant, every write atomic."""
+    """One JSON wisdom file; every read is tolerant, every write atomic
+    (and advisory-locked against concurrent recorders)."""
 
     def __init__(self, path: str):
         self.path = os.path.expanduser(str(path))
@@ -106,17 +147,37 @@ class WisdomStore:
     def _empty() -> Dict[str, Any]:
         return {"version": WISDOM_VERSION, "entries": {}}
 
+    @staticmethod
+    def _migrate_v1(raw: Dict[str, Any]) -> Dict[str, Any]:
+        """Version-1 store -> version-2 view: ``local_fft`` records are
+        variant-agnostic and carry over; ``comm`` records predate the RING
+        variant (the race that produced them never saw the ring rendering)
+        and are dropped, so they re-measure as ordinary misses. Persisted
+        as v2 by the next ``record``."""
+        entries = {}
+        for k, e in raw["entries"].items():
+            if not isinstance(e, dict):
+                continue
+            kept = {s: r for s, r in e.items() if s != "comm"}
+            if kept:
+                entries[k] = kept
+        return {"version": WISDOM_VERSION, "entries": entries}
+
     def load(self) -> Dict[str, Any]:
         """Parsed store; ANY defect (missing file, malformed JSON, wrong
-        schema or version) degrades to the empty store."""
+        schema, unknown version) degrades to the empty store. A version-1
+        store migrates (see ``_migrate_v1``) instead of reading empty."""
         try:
             with open(self.path, "r", encoding="utf-8") as f:
                 raw = json.load(f)
         except (OSError, ValueError):
             return self._empty()
         if (not isinstance(raw, dict)
-                or raw.get("version") != WISDOM_VERSION
                 or not isinstance(raw.get("entries"), dict)):
+            return self._empty()
+        if raw.get("version") == 1:
+            return self._migrate_v1(raw)
+        if raw.get("version") != WISDOM_VERSION:
             return self._empty()
         return raw
 
@@ -129,27 +190,31 @@ class WisdomStore:
         return rec if isinstance(rec, dict) else None
 
     def record(self, key: str, slot: str, rec: Dict[str, Any]) -> bool:
-        """Merge ``rec`` into the on-disk store atomically. Best-effort:
-        returns False (never raises) when the write cannot land."""
+        """Merge ``rec`` into the on-disk store atomically, holding the
+        advisory lock across the read-merge-replace window so concurrent
+        recorders serialize instead of losing each other's updates.
+        Best-effort: returns False (never raises) when the write cannot
+        land."""
         try:
-            data = self.load()  # re-read: merge with concurrent writers
-            entry = data["entries"].setdefault(key, {})
-            if not isinstance(entry, dict):  # damaged entry: replace
-                entry = data["entries"][key] = {}
-            entry[slot] = rec
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
-            try:
-                with os.fdopen(fd, "w", encoding="utf-8") as f:
-                    json.dump(data, f, indent=1, sort_keys=True)
-                os.replace(tmp, self.path)
-            finally:
-                if os.path.exists(tmp):
-                    try:
-                        os.unlink(tmp)
-                    except OSError:
-                        pass
+            with _advisory_lock(self.path):
+                data = self.load()  # re-read: merge with concurrent writers
+                entry = data["entries"].setdefault(key, {})
+                if not isinstance(entry, dict):  # damaged entry: replace
+                    entry = data["entries"][key] = {}
+                entry[slot] = rec
+                fd, tmp = tempfile.mkstemp(prefix=".wisdom.", dir=d)
+                try:
+                    with os.fdopen(fd, "w", encoding="utf-8") as f:
+                        json.dump(data, f, indent=1, sort_keys=True)
+                    os.replace(tmp, self.path)
+                finally:
+                    if os.path.exists(tmp):
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
             return True
         except (OSError, TypeError, ValueError):
             return False
@@ -427,6 +492,15 @@ def _comm_defaults(cfg):
     return dc.replace(cfg, **kw) if kw else cfg
 
 
+def _send_encoding():
+    """The index-based SendMethod wire order shared by the multihost
+    broadcast encoders/decoders (``_broadcast_comm_hit``,
+    ``_agree_across_processes``) — enum definition order, defined once so
+    a new SendMethod cannot be added to one side of the encoding only."""
+    from .. import params as pm
+    return tuple(pm.SendMethod)
+
+
 def _broadcast_comm_hit(folded, base):
     """Process 0's hit/miss decision, agreed everywhere: a per-host wisdom
     store can hit on some processes and miss on others, and a process that
@@ -439,7 +513,7 @@ def _broadcast_comm_hit(folded, base):
 
     from .. import params as pm
     comms = (pm.CommMethod.ALL2ALL, pm.CommMethod.PEER2PEER)
-    sends = (pm.SendMethod.SYNC, pm.SendMethod.STREAMS, pm.SendMethod.MPI_TYPE)
+    sends = _send_encoding()
     if folded is None:
         vec = np.full(6, -1, dtype=np.int64)
     else:
@@ -530,7 +604,7 @@ def _agree_across_processes(cfg):
     from ..ops.fft import BACKENDS
     precs = (None, "default", "high", "highest")
     comms = (pm.CommMethod.ALL2ALL, pm.CommMethod.PEER2PEER)
-    sends = (pm.SendMethod.SYNC, pm.SendMethod.STREAMS, pm.SendMethod.MPI_TYPE)
+    sends = _send_encoding()
     vec = np.asarray([
         BACKENDS.index(cfg.fft_backend),
         precs.index(cfg.mxu_precision if cfg.mxu_precision is None

@@ -129,3 +129,20 @@ def test_reference_cli_fraction_gate(devices, capsys):
     assert rc in (0, 1)  # 1 = degenerate on a hopelessly loaded host
     if rc == 0:
         assert "All2All fraction:" in out and "ceiling" in out
+
+
+def test_async_collective_counts_text_contract():
+    """The overlap detector counts op INSTANCES per form: the plain op
+    must not swallow its async -start form (or vice versa), and
+    async_total sums only the starts."""
+    txt = """
+  %a = f32[8] all-to-all(x), replica_groups={}
+  %b = f32[8] all-to-all-start(x)
+  %c = f32[8] collective-permute(x), source_target_pairs={{0,1}}
+  %d = f32[8] collective-permute(y), source_target_pairs={{1,0}}
+  %e = f32[8] collective-permute-start(z)
+"""
+    counts = mb.async_collective_counts(txt)
+    assert counts == {"all_to_all": 1, "all_to_all_start": 1,
+                      "collective_permute": 2, "collective_permute_start": 1,
+                      "async_total": 2}

@@ -59,6 +59,9 @@ class TestEnums:
         assert pm.SendMethod.parse("Sync") is pm.SendMethod.SYNC
         assert pm.SendMethod.parse("streams") is pm.SendMethod.STREAMS
         assert pm.SendMethod.parse("MPI_Type") is pm.SendMethod.MPI_TYPE
+        assert pm.SendMethod.parse("Ring") is pm.SendMethod.RING
+        assert pm.SendMethod.parse("ring") is pm.SendMethod.RING
+        assert pm.SendMethod.parse(pm.SendMethod.RING) is pm.SendMethod.RING
 
     def test_sequence_parse(self):
         S = pm.SlabSequence

@@ -160,15 +160,23 @@ def test_batched2d_streams_matches_sync(devices, rng, comm):
 
 
 def test_overlap_race_contract(devices):
-    """overlap_race: per-piece collective counts scale with the chunk count
+    """overlap_race: per-piece collective counts scale with the chunk count,
+    the ring variant races alongside with its P-1 permutes per transpose,
     and the result carries timings (or explicit degeneracy) per variant."""
     from distributedfft_tpu.testing.microbench import overlap_race
 
     r = overlap_race((16, 16, 16), 8, chunk_counts=(2,), k=3, repeats=2,
                      iterations=2, warmup=1)
-    assert set(r["variants"]) == {"sync", "streams2"}
+    assert set(r["variants"]) == {"sync", "streams2", "ring"}
     assert r["variants"]["sync"]["hlo"]["all_to_all"] == 2  # fwd + inv
     assert r["variants"]["streams2"]["hlo"]["all_to_all"] == 4
+    ring_hlo = r["variants"]["ring"]["hlo"]
+    assert ring_hlo["all_to_all"] == 0
+    # Sum plain + async-start forms: TPU lowering rewrites each permute
+    # into a collective-permute-start/done pair, so the plain form alone
+    # would read 0 there (the test_ring HLO gates count the same way).
+    assert ring_hlo["collective_permute"] + \
+        ring_hlo["collective_permute_start"] >= 14  # (P-1) x (fwd + inv)
     for v in r["variants"].values():
         assert "per_iter_ms" in v or v.get("degenerate")
 

@@ -172,6 +172,99 @@ def test_unreadable_store_degrades_on_write(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# version migration: v1 stores load without error (schema bumped to 2 when
+# the RING variant joined the comm race)
+# ---------------------------------------------------------------------------
+
+def test_v1_store_migrates_not_errors(tmp_path):
+    """A version-1 store loads as a migrated view: local_fft records are
+    variant-agnostic and carry over verbatim; comm records were winners of
+    a race that never saw the RING rendering, so they read as misses
+    (re-raced once) instead of being trusted or erroring."""
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "entries": {"k1": {"local_fft": VALID_LOCAL, "comm": VALID_COMM},
+                    "k2": {"comm": VALID_COMM},
+                    "k3": "damaged"}}))
+    store = wisdom.WisdomStore(str(p))
+    data = store.load()
+    assert data["version"] == wisdom.WISDOM_VERSION
+    assert store.lookup("k1", "local_fft") == VALID_LOCAL  # carried over
+    assert store.lookup("k1", "comm") is None              # pre-ring: miss
+    assert store.lookup("k2", "comm") is None
+    # The next record persists the migrated store as v2 on disk.
+    assert store.record("k4", "comm", VALID_COMM)
+    raw = json.loads(p.read_text())
+    assert raw["version"] == wisdom.WISDOM_VERSION
+    assert raw["entries"]["k1"] == {"local_fft": VALID_LOCAL}
+    assert "comm" not in raw["entries"].get("k1", {})
+    assert raw["entries"]["k4"]["comm"] == VALID_COMM
+
+
+def test_ring_record_roundtrip():
+    """A recorded RING winner folds back into a Config (send_method RING,
+    no chunk count) and survives the multi-controller broadcast encoding."""
+    from distributedfft_tpu.testing.autotune import CommCandidate
+    cand = CommCandidate(pm.CommMethod.ALL2ALL, None, 0,
+                         send=pm.SendMethod.RING)
+    rec = wisdom.comm_record(cand)
+    assert rec["send_method"] == "Ring" and rec["streams_chunks"] is None
+    out = wisdom._fold_comm_rec(dfft.Config(), rec)
+    assert out.send_method is pm.SendMethod.RING
+    assert out.streams_chunks is None
+    folded = dc.replace(dfft.Config(), send_method=pm.SendMethod.RING)
+    back = wisdom._broadcast_comm_hit(folded, dfft.Config())
+    assert back.send_method is pm.SendMethod.RING
+
+
+# ---------------------------------------------------------------------------
+# concurrency: N processes sharing one store cannot corrupt it or lose
+# each other's records (atomic replace + advisory lock)
+# ---------------------------------------------------------------------------
+
+_WISDOM_PY = os.path.join(REPO, "distributedfft_tpu", "utils", "wisdom.py")
+
+_WRITER = textwrap.dedent("""
+    import importlib.util, os, sys
+    spec = importlib.util.spec_from_file_location("w", sys.argv[1])
+    w = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(w)
+    store = w.WisdomStore(os.environ["DFFT_WISDOM"])
+    wid = sys.argv[2]
+    for i in range(8):
+        assert store.record(f"key-{wid}-{i}", "local_fft",
+                            {"fft_backend": "xla", "writer": wid})
+    print("WROTE", flush=True)
+""")
+
+
+def test_concurrent_fresh_process_writers(tmp_path):
+    """Four fresh processes hammer one $DFFT_WISDOM store concurrently;
+    the advisory lock serializes the read-merge-replace window, so every
+    record lands and the final file is valid versioned JSON (no torn or
+    interleaved writes). The writer loads wisdom.py standalone — the lock
+    contract must not depend on the package (or jax) being imported."""
+    env = dict(os.environ)
+    env["DFFT_WISDOM"] = str(tmp_path / "w.json")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WRITER, _WISDOM_PY, str(wid)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for wid in range(4)]
+    for pr in procs:
+        out, err = pr.communicate(timeout=120)
+        assert pr.returncode == 0 and "WROTE" in out, err[-800:]
+    raw = json.loads((tmp_path / "w.json").read_text())
+    assert raw["version"] == wisdom.WISDOM_VERSION
+    assert len(raw["entries"]) == 32  # 4 writers x 8 keys, none lost
+    store = wisdom.WisdomStore(env["DFFT_WISDOM"])
+    for wid in range(4):
+        for i in range(8):
+            rec = store.lookup(f"key-{wid}-{i}", "local_fft")
+            assert rec is not None and rec["writer"] == str(wid)
+
+
+# ---------------------------------------------------------------------------
 # construction-time resolution
 # ---------------------------------------------------------------------------
 

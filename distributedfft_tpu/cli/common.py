@@ -121,6 +121,24 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                     help="piece count for the Streams pipelined transpose "
                          "(default 4; ignored unless a send method is "
                          "Streams)")
+    ap.add_argument("--wire-dtype", "-wire",
+                    default=os.environ.get("DFFT_WIRE", "native"),
+                    choices=("native", "bf16", "auto"),
+                    help="wire encoding of the global exchanges (default "
+                         "$DFFT_WIRE or 'native'): 'native' = bit-identical "
+                         "payload; 'bf16' = OPT-IN LOSSY planar (real, imag) "
+                         "bf16 pair encoded immediately before each "
+                         "collective and decoded after — half the wire "
+                         "bytes of a complex64 exchange (~2e-3 max rel "
+                         "error per crossing, README 'wire dtype'); 'auto' "
+                         "= race compressed vs native on this shape under "
+                         "--wire-error-budget and reuse the recorded "
+                         "winner via the wisdom store")
+    ap.add_argument("--wire-error-budget", type=float, default=None,
+                    help="max rel error (vs the native path, measured on "
+                         "the actual shape) the 'auto' wire race accepts "
+                         "from a compressed wire (default 2e-2); tighter "
+                         "budgets fall back to native")
     ap.add_argument("--tc1-truth", choices=("host", "analytic"),
                     default="host",
                     help="testcase-1 ground truth: 'host' = dense random "
@@ -137,6 +155,17 @@ def wisdom_config_kwargs(args) -> dict:
     exactly: no flag + no $DFFT_WISDOM = no store is ever touched."""
     return {"wisdom_path": getattr(args, "wisdom", None),
             "use_wisdom": not getattr(args, "no_wisdom", False)}
+
+
+def wire_config_kwargs(args) -> dict:
+    """Config kwargs carrying the CLI wire surface (-wire /
+    --wire-error-budget; shared by the decomposition executables).
+    Defaults reproduce pre-wire behavior exactly: no flag + no $DFFT_WIRE
+    = the bit-identical native wire."""
+    from .. import params as pm
+    return {"wire_dtype": pm.parse_wire_dtype(
+                getattr(args, "wire_dtype", "native")),
+            "wire_error_budget": getattr(args, "wire_error_budget", None)}
 
 
 def maybe_autotune_comm(args, kind, global_size, partition, cfg,
@@ -159,12 +188,17 @@ def maybe_autotune_comm(args, kind, global_size, partition, cfg,
     print(f"autotuning comm strategies for {global_size.shape} "
           f"({kind}, {partition.num_ranks} ranks, dims={dims}):")
     base = cfg  # the config the send=None candidates were actually timed on
+    from .. import params as pm
     ranked = at.autotune_comm(kind, global_size, partition, base,
                               sequence=sequence, dims=dims,
                               transform=transform,
                               iterations=max(args.iterations, 3),
                               warmup=max(args.warmup_rounds, 1),
                               race_send=True,
+                              # -wire auto hands the wire axis to this race
+                              # (bf16 twins, error-budget-gated); an
+                              # explicit -wire is respected, not re-raced.
+                              race_wire=cfg.wire_dtype == pm.AUTO,
                               verbose=True)
     best = ranked[0]
     cfg = at.apply_best_comm(ranked, base)

@@ -34,18 +34,25 @@ import numpy as np
 
 from ..utils.timer import read_timer_csv
 
-# Slab: test_<opt>_<comm>_<snd>_<Nx>_<Ny>_<Nz>_<cuda>_<P>.csv
-# Pencil: test_<opt>_<comm1>_<snd1>_<comm2>_<snd2>_<Nx>_<Ny>_<Nz>_<cuda>_<P1>_<P2>.csv
+# Slab: test_<opt>_<comm>_<snd>_<Nx>_<Ny>_<Nz>_<cuda>_<P>[_w<wire>].csv
+# Pencil: test_<opt>_<comm1>_<snd1>_<comm2>_<snd2>_<Nx>_<Ny>_<Nz>_<cuda>
+#         _<P1>_<P2>[_w<wire>].csv
+# The optional _w<code> token is the wire-dtype extension (utils/timer
+# _WIRE_CODE; native omits it, keeping legacy names byte-for-byte) —
+# non-native wires reduce as their own variant rows, like the batched2d
+# _ck chunk variants, so compressed and native runs never merge.
 _SLAB_FILE_RE = re.compile(
     r"test_(?P<opt>\d+)_(?P<comm>\d+)_(?P<snd>\d+)_(?P<nx>\d+)_(?P<ny>\d+)"
-    r"_(?P<nz>\d+)_(?P<cuda>\d+)_(?P<p>\d+)\.csv$")
+    r"_(?P<nz>\d+)_(?P<cuda>\d+)_(?P<p>\d+)(?:_w(?P<wire>\d+))?\.csv$")
 _PENCIL_FILE_RE = re.compile(
     r"test_(?P<opt>\d+)_(?P<comm>\d+)_(?P<snd>\d+)_(?P<comm2>\d+)"
     r"_(?P<snd2>\d+)_(?P<nx>\d+)_(?P<ny>\d+)_(?P<nz>\d+)_(?P<cuda>\d+)"
-    r"_(?P<p1>\d+)_(?P<p2>\d+)\.csv$")
+    r"_(?P<p1>\d+)_(?P<p2>\d+)(?:_w(?P<wire>\d+))?\.csv$")
 
 _COMM_NAMES = {0: "Peer2Peer", 1: "All2All"}
-_SND_NAMES = {0: "Sync", 1: "Streams", 2: "MPI_Type"}
+# 3 = the RING extension, 0-2 the reference's own codes (params.hpp:87-89).
+_SND_NAMES = {0: "Sync", 1: "Streams", 2: "MPI_Type", 3: "Ring"}
+_WIRE_NAMES = {1: "bf16"}
 
 _VARIANT_LABELS = {
     "slab_default": ("Slab", "2D-1D"),
@@ -63,6 +70,11 @@ def _variant_label(variant: str):
     chunk appended so the whole open-ended family stays labeled."""
     if variant in _VARIANT_LABELS:
         return _VARIANT_LABELS[variant]
+    base, sep, w = variant.rpartition("_w")
+    if sep and w.isdigit():
+        fam, flavor = _variant_label(base)
+        wire = _WIRE_NAMES.get(int(w), f"wire{w}")
+        return fam, f"{flavor} wire={wire}".strip()
     base, sep, ck = variant.rpartition("_ck")
     if sep and ck.isdigit() and base in _VARIANT_LABELS:
         fam, flavor = _VARIANT_LABELS[base]
@@ -96,14 +108,20 @@ def scan(prefix: str) -> Dict:
             m = _PENCIL_FILE_RE.match(fname) or _SLAB_FILE_RE.match(fname)
             if not m:
                 continue
-            g = {k: int(v) for k, v in m.groupdict().items()}
+            g = {k: int(v) for k, v in m.groupdict().items()
+                 if v is not None}
             size = f"{g['nx']}_{g['ny']}_{g['nz']}"
             p = g.get("p", g.get("p1", 1) * g.get("p2", 1))
             # pencil strategy identity includes the second transpose
             comm = (g["comm"], g["comm2"]) if "comm2" in g else g["comm"]
             snd = (g["snd"], g["snd2"]) if "snd2" in g else g["snd"]
             key = (g["opt"], comm, snd, g["cuda"], p)
-            data[variant][key][size] = read_timer_csv(os.path.join(vdir, fname))
+            # Non-native wires reduce as their own variant (the CSV schema
+            # keeps them in separate files; merging them into the native
+            # rows would average lossy and lossless runs).
+            wire = g.get("wire", 0)
+            vkey = (f"{variant}_w{wire}" if wire else variant)
+            data[vkey][key][size] = read_timer_csv(os.path.join(vdir, fname))
     return data
 
 

@@ -35,7 +35,7 @@ from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
                                   pad_axis_to, ring_transpose, slice_axis_to,
-                                  split_axis_chunks)
+                                  split_axis_chunks, wire_gspmd_stages)
 from ..utils import wisdom
 from .base import _with_pad, jit_stages
 
@@ -288,6 +288,7 @@ class Batched2DFFTPlan:
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
         realigned = self.config.opt == 1
+        wire = self.config.wire_dtype
         nys_pad, nx_pad = self._nys_pad, self._nx_pad
         nx, ny, nys = self.nx, self.ny, self._ny_spec
         complex_mode = self.transform == "c2c"
@@ -302,7 +303,7 @@ class Batched2DFFTPlan:
 
             def xpose(c):
                 return all_to_all_transpose(c, SLAB_AXIS, 2, 1,
-                                            realigned=realigned)
+                                            realigned=realigned, wire=wire)
 
             def last(c):
                 c = slice_axis_to(c, 1, nx)
@@ -314,7 +315,7 @@ class Batched2DFFTPlan:
 
             def xpose(c):
                 return all_to_all_transpose(c, SLAB_AXIS, 1, 2,
-                                            realigned=realigned)
+                                            realigned=realigned, wire=wire)
 
             def last(c):
                 c = slice_axis_to(c, 2, nys)
@@ -352,12 +353,13 @@ class Batched2DFFTPlan:
             in_spec, out_spec = self._in_spec, self._out_spec
         else:
             in_spec, out_spec = self._out_spec, self._in_spec
+        wire = self.config.wire_dtype
         if self.config.send_method is pm.SendMethod.RING:
             split, concat = (2, 1) if forward else (1, 2)
 
             def rbody(v):
                 return last(ring_transpose(first(v), SLAB_AXIS, split,
-                                           concat))
+                                           concat, wire=wire))
 
             return (jax.shard_map(rbody, mesh=mesh, in_specs=in_spec,
                                   out_specs=out_spec),
@@ -377,15 +379,20 @@ class Batched2DFFTPlan:
             return (jax.shard_map(body, mesh=mesh, in_specs=in_spec,
                                   out_specs=out_spec),
                     in_spec, out_spec)
-        stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
-                               out_specs=in_spec)
-        stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
-                               out_specs=out_spec)
+        # PEER2PEER wire layer (wire_gspmd_stages, the slab contract): a
+        # compressed wire makes stage1 emit the planar bf16 encoding and
+        # stage2 decode it, so the GSPMD boundary collective moves half
+        # the bytes; "native" is the unchanged pre-wire stage pair. The
+        # STREAMS batch chunk axis (0) shifts past the plane axis.
+        stage1, stage2, bspec, shift = wire_gspmd_stages(
+            mesh, first, last, in_spec, out_spec, wire,
+            self.config.double_prec)
         if streams:
-            boundary = NamedSharding(mesh, out_spec)
+            boundary = NamedSharding(mesh, bspec)
+            ca = shift
 
             def pure(v):
-                return stage2(chunked_reshard(stage1(v), boundary, 0, k))
+                return stage2(chunked_reshard(stage1(v), boundary, ca, k))
 
             return pure, in_spec, out_spec
         return (lambda v: stage2(stage1(v)), in_spec, out_spec)

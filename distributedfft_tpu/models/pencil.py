@@ -52,10 +52,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
-from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
-                                  concat_axis_chunks,
+from ..parallel.transpose import (WIRE_NATIVE, all_to_all_transpose,
+                                  chunked_reshard, concat_axis_chunks,
                                   pad_axis_to, ring_transpose, slice_axis_to,
-                                  split_axis_chunks)
+                                  split_axis_chunks, wire_complex_dtype,
+                                  wire_decode, wire_encode)
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
 
@@ -314,6 +315,7 @@ class PencilFFTPlan(DistFFTPlan):
         realigned = self.config.opt == 1
         be = self.config.fft_backend
         st = self._mxu_st
+        wire = self.config.wire_dtype
         nzc_p2, ny_p1 = self._nzc_p2, self._ny_p1
         ny, nx = g.ny, g.nx
         complex_mode = self.transform == "c2c"
@@ -328,7 +330,8 @@ class PencilFFTPlan(DistFFTPlan):
             return c
 
         def t1(cl):
-            return all_to_all_transpose(cl, P2_AXIS, 2, 1, realigned=realigned)
+            return all_to_all_transpose(cl, P2_AXIS, 2, 1, realigned=realigned,
+                                        wire=wire)
 
         def s2(cl):
             c = slice_axis_to(cl, 1, ny)
@@ -338,7 +341,8 @@ class PencilFFTPlan(DistFFTPlan):
             return c
 
         def t2(cl):
-            return all_to_all_transpose(cl, P1_AXIS, 1, 0, realigned=realigned)
+            return all_to_all_transpose(cl, P1_AXIS, 1, 0, realigned=realigned,
+                                        wire=wire)
 
         def s3(cl):
             c = slice_axis_to(cl, 0, nx)
@@ -353,6 +357,7 @@ class PencilFFTPlan(DistFFTPlan):
         realigned = self.config.opt == 1
         be = self.config.fft_backend
         st = self._mxu_st
+        wire = self.config.wire_dtype
         nx_p1, ny_p2 = self._nx_p1, self._ny_p2
         ny, nzc, nz = g.ny, self._nz_spec, g.nz
         complex_mode = self.transform == "c2c"
@@ -362,7 +367,8 @@ class PencilFFTPlan(DistFFTPlan):
             return pad_axis_to(c, 0, nx_p1)
 
         def t2b(cl):
-            return all_to_all_transpose(cl, P1_AXIS, 0, 1, realigned=realigned)
+            return all_to_all_transpose(cl, P1_AXIS, 0, 1, realigned=realigned,
+                                        wire=wire)
 
         def i2(cl):
             c = slice_axis_to(cl, 1, ny)
@@ -370,7 +376,8 @@ class PencilFFTPlan(DistFFTPlan):
             return pad_axis_to(c, 1, ny_p2)
 
         def t1b(cl):
-            return all_to_all_transpose(cl, P2_AXIS, 1, 2, realigned=realigned)
+            return all_to_all_transpose(cl, P2_AXIS, 1, 2, realigned=realigned,
+                                        wire=wire)
 
         def i1(cl):
             c = slice_axis_to(cl, 2, nzc)
@@ -572,9 +579,11 @@ class PencilFFTPlan(DistFFTPlan):
         if snd is pm.SendMethod.RING:
             prev_fn, _ = segments[-1]
             axis_name, split, concat = xinfo
+            wire = self.config.wire_dtype
 
             def rseg(c, f=prev_fn):
-                return ring_transpose(f(c), axis_name, split, concat)
+                return ring_transpose(f(c), axis_name, split, concat,
+                                      wire=wire)
 
             segments[-1] = (rseg, spec_after)
             return False
@@ -594,18 +603,25 @@ class PencilFFTPlan(DistFFTPlan):
                 return True
             segments[-1] = (lambda c, f=prev_fn: a2a(f(c)), spec_after)
             return False
+        # PEER2PEER boundaries: when the wire compresses, the break carries
+        # the marker so _compose wraps it encode-side / decode-side (the
+        # GSPMD collective then moves the planar bf16 array). wire="native"
+        # appends the exact pre-wire break tuples.
+        wired = self.config.wire_dtype != WIRE_NATIVE
         if streams:
             segments.append((("CHUNKED_BREAK", ca,
-                              self.config.resolved_streams_chunks()),
+                              self.config.resolved_streams_chunks(), wired),
                              spec_after))
             return False
-        segments.append(("BREAK", spec_after))
+        segments.append(("WBREAK" if wired else "BREAK", spec_after))
         return False
 
     def _compose(self, segments, in_spec):
         """Fuse consecutive segments that share a shard_map into staged
         shard_maps; returns the pure composition and its out spec."""
         mesh = self.mesh
+        wire = self.config.wire_dtype
+        cdt = wire_complex_dtype(self.config.double_prec)
         stages = []
         cur_fns: List = []
         cur_in = in_spec
@@ -624,12 +640,31 @@ class PencilFFTPlan(DistFFTPlan):
             stages.append(jax.shard_map(seg, mesh=mesh, in_specs=cur_in,
                                         out_specs=cur_out))
 
+        def encode_break(spec):
+            """Close the current stage with a wire encode and open the next
+            with the decode, so the GSPMD boundary collective between them
+            moves the planar bf16 array (specs gain the leading plane
+            axis). Returns the encoded next-stage spec (the boundary's
+            target layout, for the chunked reshard's NamedSharding)."""
+            nonlocal cur_fns, cur_in, cur_out
+            cur_fns.append(lambda c: wire_encode(c, wire))
+            cur_out = PartitionSpec(None, *cur_out)
+            flush()
+            cur_fns = [lambda y: wire_decode(y, cdt, wire)]
+            cur_in = PartitionSpec(None, *spec)
+            cur_out = spec
+            return cur_in
+
         for fn, spec in segments:
             if fn == "BREAK":
                 flush()
                 cur_fns = []
                 cur_in = spec
                 cur_out = spec
+            elif fn == "WBREAK":
+                # PEER2PEER + compressed wire: the boundary reshard moves
+                # the encoded planes; the decode opens the next stage.
+                encode_break(spec)
             elif isinstance(fn, tuple) and fn[0] == "CHUNKED_BREAK":
                 # PEER2PEER + STREAMS boundary: reshard K pieces of the
                 # global array independently. Measured (8-device CPU
@@ -637,9 +672,18 @@ class PencilFFTPlan(DistFFTPlan):
                 # collective — see SlabFFTPlan._assemble_pure — so this
                 # rendering is equivalent to SYNC; the ALL2ALL rendering
                 # is the genuinely chunked pencil path.
-                flush()
-                _, ca, k = fn
-                sh = NamedSharding(mesh, spec)
+                _, ca, k, wired = fn
+                if wired:
+                    # encode_break flushes the encoded producer stage and
+                    # leaves the decode pending as the next stage's first
+                    # fn, so appending the reshard here lands it between
+                    # them: encode -> piece reshards (compressed) ->
+                    # decode. The chunk axis shifts past the plane axis.
+                    sh = NamedSharding(mesh, encode_break(spec))
+                    ca = ca + 1
+                else:
+                    flush()
+                    sh = NamedSharding(mesh, spec)
 
                 def reshard(x, sh=sh, ca=ca, k=k):
                     # The pencil chunk axes are mesh-sharded identically
@@ -650,9 +694,10 @@ class PencilFFTPlan(DistFFTPlan):
                     return chunked_reshard(x, sh, ca, k)
 
                 stages.append(reshard)
-                cur_fns = []
-                cur_in = spec
-                cur_out = spec
+                if not wired:
+                    cur_fns = []
+                    cur_in = spec
+                    cur_out = spec
             else:
                 cur_fns.append(fn)
                 cur_out = spec

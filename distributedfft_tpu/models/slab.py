@@ -62,7 +62,7 @@ from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   concat_axis_chunks,
                                   pad_axis_to, ring_transpose, slice_axis_to,
-                                  split_axis_chunks)
+                                  split_axis_chunks, wire_gspmd_stages)
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
 
@@ -272,7 +272,8 @@ class SlabFFTPlan(DistFFTPlan):
         ``split_axis`` <-> 0, leaving exactly one of {1, 2} free)."""
         return next(a for a in (1, 2) if a != self._seq.split_axis)
 
-    def _xpose_bodies(self, realigned=None, chunks: Optional[int] = None):
+    def _xpose_bodies(self, realigned=None, chunks: Optional[int] = None,
+                      wire: Optional[str] = None):
         """The pipeline's own transpose bodies ``(forward, inverse)`` for a
         given layout rendering (``realigned=None`` -> this plan's
         ``config.opt``). Single source of truth for what the slab exchange
@@ -282,15 +283,21 @@ class SlabFFTPlan(DistFFTPlan):
         ``chunks`` > 1 renders each transpose as that many independent
         per-piece collectives along the free axis (the exchange half of the
         STREAMS engine, without the interleaved FFTs — what the fraction
-        chain races to see whether chunked exchanges alone pay or win)."""
+        chain races to see whether chunked exchanges alone pay or win).
+
+        ``wire`` overrides this plan's wire encoding (``None`` -> the
+        resolved ``config.wire_dtype``) — the bench layer's wire rows time
+        exactly these bodies at each encoding."""
         if realigned is None:
             realigned = self.config.opt == 1
+        if wire is None:
+            wire = self.config.wire_dtype
         sa = self._seq.split_axis
         ca = self._streams_chunk_axis()
 
         def one(cl, split, concat):
             return all_to_all_transpose(cl, SLAB_AXIS, split, concat,
-                                        realigned=realigned)
+                                        realigned=realigned, wire=wire)
 
         if chunks is None or chunks <= 1:
             return (lambda cl: one(cl, sa, 0)), (lambda cl: one(cl, 0, sa))
@@ -476,9 +483,11 @@ class SlabFFTPlan(DistFFTPlan):
         pipe = self._ring_pipe(tuple(a for a in s.post_axes if a != 0))
         after = tuple(a for a in s.post_axes if a == 0)
         sa, nx = s.split_axis, g.nx
+        wire = self.config.wire_dtype
 
         def body(xl):
-            y = ring_transpose(first(xl), SLAB_AXIS, sa, 0, pipeline_fn=pipe)
+            y = ring_transpose(first(xl), SLAB_AXIS, sa, 0, pipeline_fn=pipe,
+                               wire=wire)
             y = slice_axis_to(y, 0, nx)
             for a in after:
                 y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
@@ -507,9 +516,11 @@ class SlabFFTPlan(DistFFTPlan):
             pipe_axes = pipe_axes + (s.r2c_axis,)
         pipe = self._ring_pipe(pipe_axes, inverse=True)
         after = tuple(a for a in reversed(s.pre_axes) if a == sa)
+        wire = self.config.wire_dtype
 
         def body(cl):
-            y = ring_transpose(first(cl), SLAB_AXIS, 0, sa, pipeline_fn=pipe)
+            y = ring_transpose(first(cl), SLAB_AXIS, 0, sa, pipeline_fn=pipe,
+                               wire=wire)
             y = slice_axis_to(y, sa, split_ext)
             for a in after:
                 y = lf.ifft(y, axis=a, norm=norm, backend=be, settings=st)
@@ -593,14 +604,22 @@ class SlabFFTPlan(DistFFTPlan):
                                      out_specs=out_spec)
             return jax.shard_map(lambda xl: last(xpose(first(xl))), mesh=mesh,
                                  in_specs=in_spec, out_specs=out_spec)
-        stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
-                               out_specs=in_spec)
-        stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
-                               out_specs=out_spec)
+        # PEER2PEER wire layer (wire_gspmd_stages): a compressed wire makes
+        # stage1 emit the planar bf16 encoding and stage2 decode it, so
+        # the GSPMD-inserted boundary collective moves the compressed
+        # array; wire="native" is the unchanged pre-wire stage pair. Under
+        # STREAMS the chunk axis shifts past the plane axis and the piece
+        # reshards move the compressed planes (GSPMD re-fuses them either
+        # way — the honest-no-op contract is unchanged, just half the
+        # bytes).
+        stage1, stage2, bspec, shift = wire_gspmd_stages(
+            mesh, first, last, in_spec, out_spec, self.config.wire_dtype,
+            self.config.double_prec)
         if not streams:
             return lambda x: stage2(stage1(x))
         ca, k, _, _ = self._streams_split()
-        boundary = NamedSharding(mesh, out_spec)
+        boundary = NamedSharding(mesh, bspec)
+        ca = ca + shift
 
         def pure(x):
             return stage2(chunked_reshard(stage1(x), boundary, ca, k))

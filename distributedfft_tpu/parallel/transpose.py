@@ -20,8 +20,127 @@ sliced off at the plan boundary.
 
 from __future__ import annotations
 
+import math
+
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
+
+# Wire dtypes of the global exchanges (the "wire layer"): how a complex
+# shard is encoded immediately before a collective and decoded immediately
+# after. NATIVE is the bit-identical pass-through (today's path); BF16
+# packs the complex payload as a planar (real, imag) bf16 pair along a new
+# leading axis, halving the wire bytes of a complex64 exchange (complex128:
+# quarter). The split is PLANAR, not interleaved, so the per-peer pieces of
+# the tiled collective stay contiguous slices of both planes and every
+# exchange rendering (default / realigned / ring) works on the encoded
+# array with its split/concat axes shifted by one. ``"auto"`` is a
+# Config-level marker (params.AUTO semantics) resolved by measurement
+# before any transpose runs; the functions here accept only the two
+# concrete encodings.
+WIRE_NATIVE = "native"
+WIRE_BF16 = "bf16"
+WIRE_DTYPES = (WIRE_NATIVE, WIRE_BF16)
+
+
+def validate_wire(wire: str) -> str:
+    if wire not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire dtype must be one of {WIRE_DTYPES} (got {wire!r}; "
+            f"'auto' must be resolved at plan construction)")
+    return wire
+
+
+def _wire_active(x, wire: str) -> bool:
+    """Whether the wire layer transforms this payload: only complex arrays
+    are compressed (every plan exchange carries post-FFT complex data; a
+    real payload passes through native so the helpers stay total)."""
+    validate_wire(wire)
+    return wire != WIRE_NATIVE and jnp.iscomplexobj(x)
+
+
+def wire_encode(x, wire: str = WIRE_BF16):
+    """Complex array -> planar (real, imag) bf16 pair along a NEW leading
+    axis (shape ``(2,) + x.shape``). Non-complex input and ``wire="native"``
+    pass through unchanged."""
+    if not _wire_active(x, wire):
+        return x
+    return jnp.stack([jnp.real(x), jnp.imag(x)]).astype(jnp.bfloat16)
+
+
+def wire_decode(y, dtype, wire: str = WIRE_BF16):
+    """Inverse of ``wire_encode``: planar pair -> complex array of
+    ``dtype`` (the payload's pre-encode complex dtype; the bf16 wire lost
+    the mantissa either way, so decoding restores only shape/dtype)."""
+    validate_wire(wire)
+    if wire == WIRE_NATIVE:
+        return y
+    f = (jnp.float64 if np.dtype(dtype) == np.complex128 else jnp.float32)
+    z = y.astype(f)
+    return lax.complex(z[0], z[1])
+
+
+def wire_complex_dtype(double_prec: bool):
+    """The complex dtype a GSPMD-boundary wire decode restores: the plan's
+    configured precision. (The explicit shard_map renderings infer the
+    payload dtype from the traced value instead; at a GSPMD stage boundary
+    the decode stage only sees the bf16 planes, so the target dtype must
+    be static — a plan fed f64 input without ``double_prec`` therefore
+    continues in complex64 downstream of a compressed boundary, which is
+    already far above the wire's bf16 precision.)"""
+    return jnp.complex128 if double_prec else jnp.complex64
+
+
+def wire_gspmd_stages(mesh, first, last, in_spec, out_spec, wire: str,
+                      double_prec: bool):
+    """The PEER2PEER (GSPMD) stage pair with the wire layer applied:
+    ``(stage1, stage2, boundary_spec, axis_shift)``. Under a compressed
+    wire, stage1 emits the planar bf16 encoding and stage2 decodes it, so
+    the GSPMD-inserted boundary collective moves the compressed array —
+    ``boundary_spec`` is then the encoded target layout (leading plane
+    axis) and ``axis_shift`` is 1 (a chunked boundary's chunk axis shifts
+    past the plane axis). ``wire="native"`` returns the plain stage pair,
+    bit-identical to the pre-wire program. Shared by the slab and
+    batched-2D engines (pencil's ``_compose`` mirrors this contract
+    inline at its WBREAK/CHUNKED_BREAK markers — keep the three in
+    sync)."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    if wire == WIRE_NATIVE:
+        stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
+                               out_specs=in_spec)
+        stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
+                               out_specs=out_spec)
+        return stage1, stage2, out_spec, 0
+    cdt = wire_complex_dtype(double_prec)
+    enc1 = PartitionSpec(None, *in_spec)
+    enc2 = PartitionSpec(None, *out_spec)
+    stage1 = jax.shard_map(lambda xl: wire_encode(first(xl), wire),
+                           mesh=mesh, in_specs=in_spec, out_specs=enc1)
+    stage2 = jax.shard_map(lambda yl: last(wire_decode(yl, cdt, wire)),
+                           mesh=mesh, in_specs=enc2, out_specs=out_spec)
+    return stage1, stage2, enc2, 1
+
+
+def wire_itemsize(dtype, wire: str = WIRE_NATIVE) -> int:
+    """Bytes ONE logical element of ``dtype`` occupies on the wire: the
+    native itemsize, or 4 for a bf16-compressed complex element (two bf16
+    planes). Non-complex payloads are never compressed."""
+    validate_wire(wire)
+    d = np.dtype(dtype)
+    if wire == WIRE_NATIVE or d.kind != "c":
+        return d.itemsize
+    return 4  # 2 planes x 2 bytes (bf16)
+
+
+def wire_nbytes(shape, dtype, wire: str = WIRE_NATIVE) -> int:
+    """Wire bytes of a full exchange payload of ``shape``/``dtype`` under
+    the given wire encoding — what the bench layer reports as
+    ``wire_bytes_per_transpose`` (vs the logical ``nbytes`` that defines
+    EFFECTIVE bandwidth)."""
+    return math.prod(int(s) for s in shape) * wire_itemsize(dtype, wire)
 
 
 def _axis_size(axis_name) -> int:
@@ -140,7 +259,7 @@ def chunked_reshard(x, target, axis: int, k: int):
 
 
 def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
-                   pipeline_fn=None):
+                   pipeline_fn=None, wire: str = WIRE_NATIVE):
     """Ring-pipelined rendering of the tiled ``lax.all_to_all`` exchange:
     the global transpose decomposed into ``P-1`` ``lax.ppermute`` steps
     (rotation offset t sends the block destined for peer ``r+t`` directly,
@@ -169,10 +288,20 @@ def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
     than ``concat_axis`` qualify; the gathered-axis FFT must wait for
     assembly.
 
+    ``wire`` selects the wire encoding of each TRAVELLING block
+    (``wire_encode`` before its ``ppermute``, ``wire_decode`` on arrival,
+    before ``pipeline_fn``) — per-block, so compression and the ring's
+    compute/communication overlap stack. The local block (step 0) never
+    touches the wire and stays exact; the monolithic collective renderings
+    by contrast compress their whole payload, resident chunk included —
+    both satisfy the same per-element error bound, the ring merely keeps
+    1/P of the data lossless for free.
+
     The ``split_axis`` extent must be divisible by the mesh axis size
     (plans pad). Must be called inside ``shard_map`` over ``axis_name``.
     """
     p = _axis_size(axis_name)
+    wired = _wire_active(x, wire)
     if pipeline_fn is None:
         def pipeline_fn(b):
             return b
@@ -199,7 +328,13 @@ def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
     blocks = [pipeline_fn(chunk(0))]
     for t in range(1, p):
         perm = [(src, (src + t) % p) for src in range(p)]
-        blocks.append(pipeline_fn(lax.ppermute(chunk(t), axis_name, perm)))
+        b = chunk(t)
+        if wired:
+            b = wire_encode(b, wire)
+        b = lax.ppermute(b, axis_name, perm)
+        if wired:
+            b = wire_decode(b, x.dtype, wire)
+        blocks.append(pipeline_fn(b))
     # Reassemble in PEER order along the concat axis (tiled all_to_all
     # semantics: the block from peer j lands at concat slot j). Block t
     # came from peer (r - t) mod p, so peer order is the arrival order
@@ -231,10 +366,21 @@ def realigned_pack_shape(shape, split_axis: int, p: int):
 
 
 def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
-                         *, realigned: bool = False):
+                         *, realigned: bool = False,
+                         wire: str = WIRE_NATIVE):
     """Redistribute inside ``shard_map``: scatter ``split_axis`` over the mesh
     axis and gather ``concat_axis`` from it — one global transpose, the
     analog of the reference's ``MPI_Alltoallv/w`` exchange.
+
+    ``wire`` selects the wire encoding of the exchange payload
+    (``WIRE_NATIVE`` = bit-identical pass-through; ``WIRE_BF16`` = planar
+    (real, imag) bf16 pair, half the wire bytes of a complex64 payload).
+    The encode happens immediately before the collective and the decode
+    immediately after, on the planar array with the split/concat axes
+    shifted past the new leading plane axis — so it composes with both the
+    default and the realigned (opt1) rendering unchanged: the realigned
+    pack merges the plane axis into its peer-major leading chunks and each
+    peer's contiguous piece simply carries both planes of its block.
 
     ``realigned`` is the TPU rendering of the reference's "opt1" coordinate
     transform (``include/mpicufft_slab_opt1.hpp:46-54``): pack the block so
@@ -255,6 +401,18 @@ def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
     moves the pipeline transpose pair from 0.59x to ~1.0x of the pure
     exchange ceiling (the north-star gate).
     """
+    if _wire_active(x, wire):
+        y = wire_encode(x, wire)
+        y = _all_to_all_native(y, axis_name, split_axis + 1, concat_axis + 1,
+                               realigned)
+        return wire_decode(y, x.dtype, wire)
+    return _all_to_all_native(x, axis_name, split_axis, concat_axis,
+                              realigned)
+
+
+def _all_to_all_native(x, axis_name: str, split_axis: int, concat_axis: int,
+                       realigned: bool):
+    """The exchange proper, on whatever array the wire layer hands it."""
     if not realigned:
         return lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)

@@ -59,6 +59,32 @@ _MXU_PRECISIONS = frozenset({"default", "high", "highest"})
 # construction.
 AUTO = "auto"
 
+# Valid Config.wire_dtype names: how the global-exchange payload is encoded
+# on the wire. "native" is the bit-identical pass-through; "bf16" the
+# planar (real, imag) bf16 pair (parallel/transpose.wire_encode) halving a
+# complex64 exchange's bytes; "auto" races compressed vs native under
+# Config.wire_error_budget at plan construction (wisdom-resolved, like the
+# comm "auto").
+_WIRE_DTYPES = ("native", "bf16", AUTO)
+
+# Default Config.wire_error_budget: the max roundtrip rel error (vs the
+# native path, relative to the output's max magnitude) the "auto" racer
+# accepts from a compressed wire. bf16 carries an 8-bit mantissa
+# (eps ~ 3.9e-3); a forward+inverse pipeline crosses the wire twice, so
+# the measured roundtrip error sits at ~2-4e-3 per crossing — 2e-2 admits
+# bf16 for ordinary f32 workloads while rejecting it wherever accumulation
+# pushes past the percent level.
+DEFAULT_WIRE_ERROR_BUDGET = 2e-2
+
+
+def parse_wire_dtype(s: str) -> str:
+    """Canonical wire-dtype name (case-insensitive; 'auto' = measured)."""
+    key = str(s).strip().lower()
+    if key in _WIRE_DTYPES:
+        return key
+    raise ValueError(
+        f"unknown wire dtype: {s!r} (choose from {_WIRE_DTYPES})")
+
 
 def parse_comm_method(s: "str | CommMethod") -> "str | CommMethod":
     """``CommMethod.parse`` that additionally accepts ``"auto"`` (the
@@ -329,6 +355,21 @@ class Config:
     chunk axis extent at trace time. More chunks = more overlap windows
     but smaller (less bandwidth-efficient) exchanges.
 
+    ``wire_dtype`` selects the WIRE encoding of every global exchange
+    (``parallel/transpose`` wire layer; CLI ``-wire``, env ``$DFFT_WIRE``):
+    ``"native"`` keeps today's bit-identical payload; ``"bf16"`` packs the
+    complex payload as a planar (real, imag) bf16 pair immediately before
+    the collective and decodes immediately after — HALF the wire bytes of
+    a complex64 exchange (quarter for complex128), an OPT-IN LOSSY choice
+    (~2e-3 max rel error per crossing, measured/documented in README);
+    ``"auto"`` races compressed vs native on the actual shape at plan
+    construction, accepts bf16 only when its measured roundtrip error
+    stays within ``wire_error_budget`` (None -> 2e-2), and records the
+    winner in the wisdom store. The encoding composes with every exchange
+    rendering — default/opt1 ``lax.all_to_all``, the GSPMD boundary, and
+    the RING ppermute ring, which encodes per travelling block so
+    compression and overlap stack. Applies to both pencil transposes.
+
     ``fft3d_chunk`` bounds the SINGLE-DEVICE 3D path's peak memory: the
     z+y stages run as ``lax.map`` over that many leading-axis chunks, so
     the four-step relayout temporaries scale with a chunk instead of the
@@ -373,6 +414,8 @@ class Config:
     mxu_direct_max: Optional[int] = None
     fft3d_chunk: Optional[int] = None
     streams_chunks: Optional[int] = None
+    wire_dtype: str = "native"
+    wire_error_budget: Optional[float] = None
     wisdom_path: Optional[str] = None
     use_wisdom: bool = True
 
@@ -416,6 +459,16 @@ class Config:
             raise ValueError(
                 f"streams_chunks must be a positive int or None, "
                 f"got {self.streams_chunks!r}")
+        if self.wire_dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype must be one of {_WIRE_DTYPES}, "
+                f"got {self.wire_dtype!r} (parse_wire_dtype canonicalizes)")
+        if self.wire_error_budget is not None and (
+                not isinstance(self.wire_error_budget, (int, float))
+                or not self.wire_error_budget > 0):
+            raise ValueError(
+                f"wire_error_budget must be a positive number or None, "
+                f"got {self.wire_error_budget!r}")
 
     def mxu_settings(self):
         """The plan's ``mxu_fft.MXUSettings``, or None when every knob is
@@ -455,3 +508,9 @@ class Config:
     def resolved_streams_chunks(self) -> int:
         """Chunk count for the STREAMS pipelined transpose (None -> 4)."""
         return self.streams_chunks if self.streams_chunks is not None else 4
+
+    def resolved_wire_budget(self) -> float:
+        """Max rel error the 'auto' wire race accepts from a compressed
+        wire (None -> DEFAULT_WIRE_ERROR_BUDGET)."""
+        return (self.wire_error_budget if self.wire_error_budget is not None
+                else DEFAULT_WIRE_ERROR_BUDGET)

@@ -188,14 +188,21 @@ class CommCandidate:
     crossed with the send-method axis (``send``/``chunks``: the STREAMS
     chunked-pipelined transpose at a given piece count, or the RING
     ppermute rendering; ``send=None`` keeps the base config's monolithic
-    SYNC exchange — the reference's ``-snd``/``-snd2`` dimension)."""
+    SYNC exchange — the reference's ``-snd``/``-snd2`` dimension) and the
+    wire-dtype axis (``wire``: the exchange payload encoding —
+    ``"bf16"`` candidates carry their measured forward error vs the
+    native reference in ``wire_rel_err`` and are GATED on the error
+    budget; ``wire=None`` keeps the base config's wire and is never
+    folded, so an un-raced axis cannot clobber an explicit choice)."""
     comm: object                 # CommMethod for transpose 1
     comm2: Optional[object]      # pencil transpose 2 (None for slab)
     opt: int
     send: object = None          # SendMethod.STREAMS/RING variants only
     chunks: Optional[int] = None  # streams_chunks for send=STREAMS
+    wire: Optional[str] = None   # wire dtype; None = base config's (unraced)
     fwd_ms: float = float("nan")
     inv_ms: float = float("nan")
+    wire_rel_err: float = float("nan")  # bf16 only: fwd max rel err vs native
     ok: bool = False
     error: Optional[str] = None
 
@@ -208,9 +215,13 @@ class CommCandidate:
         c1 = self.comm.value
         tag = c1 if self.comm2 is None else f"{c1}+{self.comm2.value}"
         tag = f"{tag}/opt{self.opt}"
-        if self.send is not None:
-            tag += ("/ring" if getattr(self.send, "name", None) == "RING"
-                    else f"/streams{self.chunks}")
+        name = getattr(self.send, "name", None)
+        if name == "RING":
+            tag += "/ring"
+        elif name == "STREAMS":
+            tag += f"/streams{self.chunks}"
+        if self.wire not in (None, "native"):
+            tag += f"/{self.wire}"
         return tag
 
 
@@ -224,12 +235,115 @@ def _time_plan_ms(fn, x, iterations: int, warmup: int) -> float:
     return _time_fn(fn, x, iterations, warmup) * 1e3
 
 
+def _measure_comm_candidates(cands, kind, global_size, partition, base,
+                             mesh, sequence, dims, transform, iterations,
+                             warmup, seed, budget, verbose):
+    """Shared measurement loop of the comm/wire racers: time every
+    candidate's forward+inverse on the active mesh, and gate compressed-
+    wire candidates on their measured forward error vs the FIRST
+    successful native candidate's output (all native renderings agree on
+    the forward output, so one reference serves every twin). Candidate
+    lists must therefore order natives before compressed twins."""
+    import dataclasses as dc
+
+    import numpy as np
+
+    from . import testcases as tc
+
+    rdt = np.float64 if base.double_prec else np.float32
+    xs = np.random.default_rng(seed).random(
+        tuple(global_size.shape)).astype(rdt)
+    ref_spec = None
+    for c in cands:
+        try:
+            cfg = dc.replace(base, comm_method=c.comm, comm_method2=c.comm2,
+                             opt=c.opt)
+            if c.send is not None:
+                cfg = dc.replace(cfg, send_method=c.send, send_method2=None,
+                                 streams_chunks=c.chunks)
+            if c.wire is not None:
+                cfg = dc.replace(cfg, wire_dtype=c.wire)
+            plan = tc.make_plan(kind, global_size, partition, cfg,
+                                sequence=sequence, mesh=mesh,
+                                transform=transform)
+            x = plan.pad_input(xs)
+            fwd, inv = tc._fused_fns(plan, dims)
+            c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
+            spec = fwd(x)
+            c.inv_ms = _time_plan_ms(inv, spec, iterations, warmup)
+            compressed = c.wire not in (None, "native")
+            if not compressed and ref_spec is None:
+                ref_spec = spec
+            if compressed:
+                # The gate runs BEFORE ok is set: a lossy candidate whose
+                # accuracy could not be established (no native reference,
+                # or the error computation itself failed) must never rank
+                # as usable.
+                if ref_spec is None:
+                    raise RuntimeError(
+                        "no native reference measured before the "
+                        "compressed candidate (racer list-order contract)")
+                from .microbench import max_rel_err
+                c.wire_rel_err = max_rel_err(spec, ref_spec)
+                if not c.wire_rel_err <= budget:
+                    c.error = (f"wire rel err {c.wire_rel_err:.2e} over "
+                               f"budget {budget:.0e}")
+                else:
+                    c.ok = True
+            else:
+                c.ok = True
+        except Exception as e:  # strategy unavailable for this shape/mesh
+            c.ok = False
+            c.error = f"{type(e).__name__}: {e}"
+        if verbose:
+            werr = ("" if not np.isfinite(c.wire_rel_err)
+                    else f"  wire_err {c.wire_rel_err:.2e}")
+            print(f"  {c.label:28s} fwd {c.fwd_ms:8.3f} ms  "
+                  f"inv {c.inv_ms:8.3f} ms  ok={c.ok}{werr}"
+                  + (f"  ({c.error})" if c.error else ""), flush=True)
+
+
+def _rank_and_agree(cands) -> List[CommCandidate]:
+    """Sort measured candidates fastest-first, then (multi-controller
+    only) force agreement on process 0's winner — candidates are
+    routinely within noise, and divergent Configs build mismatched
+    collective programs across processes (hang). The broadcast is
+    UNCONDITIONAL (sentinel -1 = "nothing ok here"): a process whose
+    candidates all failed locally must still issue the same collective as
+    its peers or the agreement step deadlocks."""
+    import numpy as np
+
+    ranked = sorted(cands, key=lambda c: (
+        not c.ok,
+        c.total_ms if np.isfinite(c.total_ms) else float("inf")))
+
+    import jax
+    if jax.process_count() > 1 and ranked:
+        from jax.experimental import multihost_utils
+        idx = (next(i for i, c in enumerate(cands) if c is ranked[0])
+               if ranked[0].ok else -1)
+        idx = int(multihost_utils.broadcast_one_to_all(np.int32(idx)))
+        if idx >= 0:
+            win = cands[idx]
+            ranked.remove(win)
+            ranked.insert(0, win)
+        else:
+            # Process 0 saw no usable strategy: fail identically everywhere
+            # (a per-process mix of success and failure diverges later).
+            for c in ranked:
+                c.ok = False
+                c.error = c.error or "process 0 had no usable strategy"
+    return ranked
+
+
 def autotune_comm(kind: str, global_size, partition, base_config=None,
                   mesh=None, sequence=None, iterations: int = 5,
                   warmup: int = 2, race_opt: bool = True, seed: int = 0,
                   dims: int = 3, transform: str = "r2c",
                   race_send: bool = False,
                   streams_chunks: Sequence[int] = (4,),
+                  race_wire: bool = False,
+                  wire_error_budget: Optional[float] = None,
                   verbose: bool = False) -> List[CommCandidate]:
     """Race the communication strategies for a plan shape ON the active
     mesh: ALL2ALL (explicit ``lax.all_to_all``) vs PEER2PEER (GSPMD
@@ -255,17 +369,37 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
     one collective (measured, ``models/slab._assemble_pure``), so a
     P2P+STREAMS candidate would mismeasure a program identical to SYNC.
 
+    ``race_wire=True`` adds the wire-dtype axis: every candidate cell
+    gains a ``wire="bf16"`` twin (compression interacts with the
+    rendering — per-block on the ring, whole-payload on the collectives —
+    so the wire axis is crossed, not raced once like the ring). Twins are
+    GATED on accuracy: each twin's forward output is compared against the
+    first native candidate's (max rel error, relative to the reference's
+    max magnitude, computed on device) and a twin over
+    ``wire_error_budget`` (None -> the base config's
+    ``resolved_wire_budget``) is marked not-ok, so a lossy wire can only
+    win inside the user's error budget. Natives then carry
+    ``wire="native"`` explicitly, so the fold records whichever side won.
+
     Returns candidates sorted by measured forward+inverse time; apply the
     winner with ``apply_best_comm``.
     """
     import dataclasses as dc
 
-    import numpy as np
-
-    from ..params import CommMethod, Config, SendMethod
-    from . import testcases as tc
+    from ..params import AUTO, CommMethod, Config, SendMethod
 
     base = base_config or Config()
+    if base.wire_dtype == AUTO or race_wire:
+        # Candidate plans must never construct with an unresolved marker
+        # (recursion into wisdom resolution), and race_wire OWNS the
+        # axis: any base wire — "auto" or an explicit "bf16" — is
+        # normalized to native so un-twinned candidates are the error
+        # reference and only the explicit twins run compressed (an
+        # un-normalized bf16 base would run every candidate lossy with
+        # the accuracy gate silently skipped).
+        base = dc.replace(base, wire_dtype="native")
+    budget = (wire_error_budget if wire_error_budget is not None
+              else base.resolved_wire_budget())
     both = (CommMethod.ALL2ALL, CommMethod.PEER2PEER)
     opts = (0, 1) if race_opt else (base.opt,)
     race_comm2 = kind == "pencil" and dims >= 3
@@ -287,61 +421,58 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
                         # candidate, not a duplicate per matrix cell.
                         cands.append(CommCandidate(cc1, cc2, opt,
                                                    send=SendMethod.RING))
+    if race_wire:
+        # Natives first (the twins' error reference), then the bf16 twin
+        # of every cell. Explicit wire on both sides: the raced axis is
+        # always folded, an unraced one (wire=None) never is.
+        for c in cands:
+            c.wire = "native"
+        cands = cands + [dc.replace(c, wire="bf16") for c in cands]
 
-    rdt = np.float64 if base.double_prec else np.float32
-    xs = np.random.default_rng(seed).random(
-        tuple(global_size.shape)).astype(rdt)
-    for c in cands:
-        try:
-            cfg = dc.replace(base, comm_method=c.comm, comm_method2=c.comm2,
-                             opt=c.opt)
-            if c.send is not None:
-                cfg = dc.replace(cfg, send_method=c.send, send_method2=None,
-                                 streams_chunks=c.chunks)
-            plan = tc.make_plan(kind, global_size, partition, cfg,
-                                sequence=sequence, mesh=mesh,
-                                transform=transform)
-            x = plan.pad_input(xs)
-            fwd, inv = tc._fused_fns(plan, dims)
-            c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
-            spec = fwd(x)
-            c.inv_ms = _time_plan_ms(inv, spec, iterations, warmup)
-            c.ok = True
-        except Exception as e:  # strategy unavailable for this shape/mesh
-            c.error = f"{type(e).__name__}: {e}"
-        if verbose:
-            print(f"  {c.label:28s} fwd {c.fwd_ms:8.3f} ms  "
-                  f"inv {c.inv_ms:8.3f} ms  ok={c.ok}"
-                  + (f"  ({c.error})" if c.error else ""), flush=True)
-    ranked = sorted(cands, key=lambda c: (
-        not c.ok,
-        c.total_ms if np.isfinite(c.total_ms) else float("inf")))
+    _measure_comm_candidates(cands, kind, global_size, partition, base,
+                             mesh, sequence, dims, transform, iterations,
+                             warmup, seed, budget, verbose)
+    return _rank_and_agree(cands)
 
-    import jax
-    if jax.process_count() > 1 and ranked:
-        # Multi-controller runs must AGREE on the winner: candidates are
-        # routinely within noise of each other, and divergent Configs would
-        # build mismatched collective programs across processes (hang).
-        # The candidate list order is deterministic, so broadcasting
-        # process 0's winning index is sufficient agreement. The broadcast
-        # itself is UNCONDITIONAL (sentinel -1 = "nothing ok here"): a
-        # process whose candidates all failed locally must still issue the
-        # same collective as its peers or the agreement step deadlocks.
-        from jax.experimental import multihost_utils
-        idx = (next(i for i, c in enumerate(cands) if c is ranked[0])
-               if ranked[0].ok else -1)
-        idx = int(multihost_utils.broadcast_one_to_all(np.int32(idx)))
-        if idx >= 0:
-            win = cands[idx]
-            ranked.remove(win)
-            ranked.insert(0, win)
-        else:
-            # Process 0 saw no usable strategy: fail identically everywhere
-            # (a per-process mix of success and failure diverges later).
-            for c in ranked:
-                c.ok = False
-                c.error = c.error or "process 0 had no usable strategy"
-    return ranked
+
+def autotune_wire(kind: str, global_size, partition, base_config=None,
+                  mesh=None, sequence=None, iterations: int = 5,
+                  warmup: int = 2, seed: int = 0, dims: int = 3,
+                  transform: str = "r2c",
+                  error_budget: Optional[float] = None,
+                  verbose: bool = False) -> List[CommCandidate]:
+    """Race ONLY the wire-dtype axis on the base config's fixed comm/send
+    rendering — the ``Config(wire_dtype="auto")`` path when the comm
+    choice is explicit (a concrete ``comm_method`` must not be re-raced
+    behind the user's back; compare ``autotune_comm(race_wire=True)``,
+    which owns both axes for ``comm_method="auto"``).
+
+    Two candidates: the base rendering at ``wire="native"`` (the error
+    reference) and at ``wire="bf16"``, gated on ``error_budget`` (None ->
+    the base config's ``resolved_wire_budget``) exactly like the combined
+    race's twins. Returns candidates sorted fastest-first (budget
+    failures last); fold the winner with ``apply_best_comm``.
+    """
+    import dataclasses as dc
+
+    from ..params import AUTO, Config
+
+    base = base_config or Config()
+    if base.wire_dtype == AUTO:
+        base = dc.replace(base, wire_dtype="native")
+    budget = (error_budget if error_budget is not None
+              else base.resolved_wire_budget())
+    comm2 = base.comm_method2 if kind == "pencil" else None
+    # send stays None: the measurement then runs the base config's send
+    # methods UNCHANGED (send_method2 included) — setting it would make
+    # _measure_comm_candidates normalize send_method2 to None and the race
+    # would time/gate a rendering the caller never runs.
+    cands = [CommCandidate(base.comm_method, comm2, base.opt, wire=w)
+             for w in ("native", "bf16")]
+    _measure_comm_candidates(cands, kind, global_size, partition, base,
+                             mesh, sequence, dims, transform, iterations,
+                             warmup, seed, budget, verbose)
+    return _rank_and_agree(cands)
 
 
 def apply_best_comm(candidates: List[CommCandidate], base_config=None):
@@ -368,6 +499,11 @@ def apply_best_comm(candidates: List[CommCandidate], base_config=None):
         # --send-method the caller chose not to race).
         cfg = dc.replace(cfg, send_method=best.send, send_method2=None,
                          streams_chunks=best.chunks)
+    if best.wire is not None:
+        # Same contract for the wire axis: fold only when it was raced
+        # (race_wire / autotune_wire set it explicitly on every
+        # candidate); wire=None preserves the caller's wire_dtype.
+        cfg = dc.replace(cfg, wire_dtype=best.wire)
     return cfg
 
 

@@ -62,12 +62,38 @@ def async_collective_counts(hlo) -> Dict[str, int]:
     nonzero ``*_start`` counts are the evidence the backend scheduled the
     transfers asynchronously (TPU emits start/done pairs; the CPU backend
     lowers every collective synchronously, so its ``async_total`` is 0 by
-    construction). Accepts a compiled executable or raw HLO text."""
+    construction). Accepts a compiled executable or raw HLO text.
+
+    ``convert`` counts the dtype-conversion ops in the module — the
+    compressed-wire encode/decode casts (``wire_dtype="bf16"``) land as
+    ``convert``s fused into/around the collective operands. The count
+    attributes a compressed program's extra ops, and the wire tier-1 gate
+    (tests/test_wire.py) asserts the compression did NOT break the
+    ``>= P-1`` collective-permute signature of ring plans: if GSPMD ever
+    re-fused the encoded permutes, the permute count would collapse and
+    the gate fails by count, not by timing drift."""
     txt = hlo if isinstance(hlo, str) else hlo.as_text()
     out = {name: txt.count(f" {op}(") for name, op in _ASYNC_HLO_FORMS}
     out["async_total"] = (out["all_to_all_start"]
                           + out["collective_permute_start"])
+    out["convert"] = txt.count(" convert(")
     return out
+
+
+# Module-level so repeated calls (one per bf16 twin in a race, plus the
+# bench wire rows) share one jit cache entry per shape/dtype instead of
+# re-tracing a fresh lambda every time. jax.jit is lazy: building the
+# wrapper at import touches no backend.
+_max_rel_err = jax.jit(
+    lambda u, v: jnp.max(jnp.abs(u - v)) / jnp.max(jnp.abs(v)))
+
+
+def max_rel_err(a, b) -> float:
+    """Max ``|a - b|`` relative to ``max |b|``, computed on device (one
+    scalar readback, so it works on distributed global arrays) — the wire
+    layer's single accuracy metric, shared by the autotune error gate and
+    the bench wire rows so the two can never drift apart."""
+    return float(_max_rel_err(a, b))
 
 
 def _collectives_in(compiled) -> list:

@@ -38,6 +38,20 @@ _COMM_CODE = {CommMethod.PEER2PEER: 0, CommMethod.ALL2ALL: 1}
 # eval reduction keys on the literal code, so new codes only add rows.
 _SEND_CODE = {SendMethod.SYNC: 0, SendMethod.STREAMS: 1, SendMethod.MPI_TYPE: 2,
               SendMethod.RING: 3}
+# Wire-dtype filename codes (mirroring the send-code-3 extension pattern):
+# the reference schema has no wire slot, so the NATIVE wire keeps the
+# legacy filename byte-for-byte (pre-wire CSVs stay comparable) and a
+# compressed wire appends a ``_w<code>`` token before ``.csv`` — runs with
+# different wire dtypes can never interleave into one CSV as if they were
+# iterations of a single config.
+_WIRE_CODE = {"native": 0, "bf16": 1}
+
+
+def _wire_suffix(config: Config) -> str:
+    wire = getattr(config, "wire_dtype", "native")
+    code = _WIRE_CODE[wire]  # KeyError on unresolved/unknown, like the
+    # comm/send code tables — plans resolve "auto" before a Timer exists.
+    return "" if code == 0 else f"_w{code}"
 
 
 def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
@@ -52,6 +66,7 @@ def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
     comm = _COMM_CODE[config.comm_method]
     snd = _SEND_CODE[config.send_method]
     cuda = 1 if config.cuda_aware else 0
+    wire = _wire_suffix(config)
     g = global_size
     d = os.path.join(benchmark_dir, variant)
     if pencil_grid is not None:
@@ -60,9 +75,10 @@ def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
         p1, p2 = pencil_grid
         return os.path.join(
             d, f"test_{config.opt}_{comm}_{snd}_{comm2}_{snd2}"
-               f"_{g.nx}_{g.ny}_{g.nz}_{cuda}_{p1}_{p2}.csv")
+               f"_{g.nx}_{g.ny}_{g.nz}_{cuda}_{p1}_{p2}{wire}.csv")
     return os.path.join(
-        d, f"test_{config.opt}_{comm}_{snd}_{g.nx}_{g.ny}_{g.nz}_{cuda}_{pcnt}.csv")
+        d, f"test_{config.opt}_{comm}_{snd}_{g.nx}_{g.ny}_{g.nz}_{cuda}"
+           f"_{pcnt}{wire}.csv")
 
 
 class Timer:

@@ -21,8 +21,9 @@ zero measurement time.
 
 Store format: ONE JSON file::
 
-    {"version": 2,
-     "entries": {"<canonical key json>": {"local_fft": {...}, "comm": {...}}}}
+    {"version": 3,
+     "entries": {"<canonical key json>": {"local_fft": {...}, "comm": {...},
+                                          "wire": {...}}}}
 
 Keys fold in everything that can change a winner: platform, device kind,
 jax version, global shape, dtype, mesh shape, decomposition (kind +
@@ -30,11 +31,15 @@ partition grid + sequence/variant + transform), and norm. A key built on a
 different mesh, dtype or jax version simply misses.
 
 Version 2 added the RING (ppermute-ring) rendering to the comm race.
-Version-1 stores MIGRATE rather than error: their ``local_fft`` records
-are variant-agnostic and carry over verbatim, while their ``comm`` records
-were winners of a race that never saw the ring variant and therefore read
-as misses (re-raced once, re-recorded under v2). Any later/unknown version
-reads as empty.
+Version 3 added the WIRE axis: ``comm`` records gained ``wire_dtype``
+(the comm race crosses every cell with the bf16 compressed-wire twin,
+error-budget-gated), and the ``wire`` slot records the wire-only race run
+for ``Config(wire_dtype="auto")`` with an explicit comm method. Legacy
+stores MIGRATE rather than error: ``local_fft`` (and any other
+non-``comm``) records are wire-agnostic and carry over verbatim, while
+v1/v2 ``comm`` records were winners of races that never saw the ring
+(v1) or wire (v1/v2) axis and therefore read as misses (re-raced once,
+re-recorded under v3). Any later/unknown version reads as empty.
 
 Degradation contract: a missing, corrupt, partially-valid or
 version-mismatched store reads as EMPTY (re-measure); a record whose fields
@@ -63,8 +68,14 @@ import os
 import tempfile
 from typing import Any, Dict, Optional, Sequence, Tuple
 
-WISDOM_VERSION = 2
+WISDOM_VERSION = 3
+# Store versions that migrate on load instead of reading empty (their
+# non-"comm" slots carry over; see _migrate_legacy).
+_LEGACY_VERSIONS = (1, 2)
 ENV_VAR = "DFFT_WISDOM"
+# Wire dtypes a stored record may carry (the "auto" marker never lands on
+# disk — records hold measured winners).
+_WIRE_CONCRETE = ("native", "bf16")
 
 # Bounded construction-time race defaults. The local chain length is the
 # floor that still cancels dispatch noise on CPU-class timers; raise
@@ -148,12 +159,13 @@ class WisdomStore:
         return {"version": WISDOM_VERSION, "entries": {}}
 
     @staticmethod
-    def _migrate_v1(raw: Dict[str, Any]) -> Dict[str, Any]:
-        """Version-1 store -> version-2 view: ``local_fft`` records are
-        variant-agnostic and carry over; ``comm`` records predate the RING
-        variant (the race that produced them never saw the ring rendering)
-        and are dropped, so they re-measure as ordinary misses. Persisted
-        as v2 by the next ``record``."""
+    def _migrate_legacy(raw: Dict[str, Any]) -> Dict[str, Any]:
+        """Version-1/2 store -> version-3 view: ``local_fft`` (and any
+        other non-``comm``) records are wire-agnostic and carry over;
+        ``comm`` records predate an axis of the race (the RING rendering
+        for v1, the wire dtype for v1 and v2) and are dropped, so they
+        re-measure as ordinary misses. Persisted as v3 by the next
+        ``record``."""
         entries = {}
         for k, e in raw["entries"].items():
             if not isinstance(e, dict):
@@ -166,7 +178,8 @@ class WisdomStore:
     def load(self) -> Dict[str, Any]:
         """Parsed store; ANY defect (missing file, malformed JSON, wrong
         schema, unknown version) degrades to the empty store. A version-1
-        store migrates (see ``_migrate_v1``) instead of reading empty."""
+        or -2 store migrates (see ``_migrate_legacy``) instead of reading
+        empty."""
         try:
             with open(self.path, "r", encoding="utf-8") as f:
                 raw = json.load(f)
@@ -175,8 +188,8 @@ class WisdomStore:
         if (not isinstance(raw, dict)
                 or not isinstance(raw.get("entries"), dict)):
             return self._empty()
-        if raw.get("version") == 1:
-            return self._migrate_v1(raw)
+        if raw.get("version") in _LEGACY_VERSIONS:
+            return self._migrate_legacy(raw)
         if raw.get("version") != WISDOM_VERSION:
             return self._empty()
         return raw
@@ -336,6 +349,45 @@ def comm_record(candidate, base_config=None) -> Dict[str, Any]:
         if isinstance(sm, pm.SendMethod) and sm is not pm.SendMethod.SYNC:
             rec["send_method"] = sm.value
             rec["streams_chunks"] = base_config.streams_chunks
+    # Wire axis (store schema v3): the raced wire, or the base config's
+    # when the axis was not raced (wire=None candidates were timed with
+    # the base's wire — the recorded program must be the measured one).
+    # An unresolved "auto" (racers normalize it to native before timing)
+    # lands on disk as the native it actually ran.
+    w = candidate.wire
+    if w is None:
+        w = getattr(base_config, "wire_dtype", None)
+    rec["wire_dtype"] = w if w in _WIRE_CONCRETE else "native"
+    # Whether the wire axis was actually raced (race_wire twins) or just
+    # inherited from the base: a later wire="auto" must re-race a record
+    # whose native wire never competed against the compressed twin. A
+    # raced record also carries the error budget the race ran under
+    # (``wire_budget``) — a native winner is only a valid hit for budgets
+    # at least as tight (see ``_wire_hit_within_budget``).
+    rec["wire_raced"] = candidate.wire is not None
+    if rec["wire_raced"] and base_config is not None:
+        try:
+            rec["wire_budget"] = float(base_config.resolved_wire_budget())
+        except AttributeError:
+            pass
+    if np.isfinite(getattr(candidate, "wire_rel_err", float("nan"))):
+        rec["wire_rel_err"] = float(f"{candidate.wire_rel_err:.3e}")
+    if np.isfinite(candidate.total_ms):
+        rec["total_ms"] = round(float(candidate.total_ms), 4)
+    return rec
+
+
+def wire_record(candidate, budget: Optional[float] = None) -> Dict[str, Any]:
+    """Serialize an ``autotune_wire`` winner for the ``wire`` slot (the
+    wire-only race: comm explicit, ``wire_dtype="auto"``). ``budget`` is
+    the error budget the race ran under (recorded so a later LOOSER
+    budget re-considers a twin this race rejected)."""
+    import numpy as np
+    rec = {"wire_dtype": candidate.wire or "native"}
+    if budget is not None:
+        rec["wire_budget"] = float(budget)
+    if np.isfinite(getattr(candidate, "wire_rel_err", float("nan"))):
+        rec["wire_rel_err"] = float(f"{candidate.wire_rel_err:.3e}")
     if np.isfinite(candidate.total_ms):
         rec["total_ms"] = round(float(candidate.total_ms), 4)
     return rec
@@ -379,7 +431,47 @@ def _fold_comm_rec(cfg, rec):
             raise ValueError(f"stale streams_chunks {chunks!r}")
         cfg = dc.replace(cfg, send_method=pm.SendMethod.parse(
             rec["send_method"]), send_method2=None, streams_chunks=chunks)
-    return cfg
+    # v3 records always carry the wire axis; a hand-edited record missing
+    # it folds as native (the conservative, bit-identical wire).
+    wire = rec.get("wire_dtype", "native")
+    if wire not in _WIRE_CONCRETE:
+        raise ValueError(f"stale wire_dtype {wire!r}")
+    return dc.replace(cfg, wire_dtype=wire)
+
+
+def _fold_wire_rec(cfg, rec):
+    """Fold a stored ``wire``-slot record into a Config; raises on
+    stale/invalid fields (callers treat that as a miss)."""
+    import dataclasses as dc
+    wire = rec.get("wire_dtype")
+    if wire not in _WIRE_CONCRETE:
+        raise ValueError(f"stale wire_dtype {wire!r}")
+    return dc.replace(cfg, wire_dtype=wire)
+
+
+def _wire_hit_within_budget(rec, budget: float) -> bool:
+    """Whether a recorded wire winner satisfies the CALLER'S error budget.
+    The budget is not part of the plan key (two runs differing only in
+    ``wire_error_budget`` share an entry), so the check happens at fold
+    time, in both directions:
+
+    * a recorded bf16 winner hits only if its recorded measured error is
+      within the caller's — possibly tighter — budget (missing error
+      field = miss, re-race under the caller's budget);
+    * a recorded NATIVE winner hits only for budgets at least as tight as
+      the one it was raced under (``wire_budget``): a LOOSER caller
+      budget could admit the compressed twin that race rejected, so the
+      hit must re-race rather than permanently pin native. A legacy
+      record without ``wire_budget`` hits (native is always numerically
+      safe; only a possible perf win is at stake, and the next raced
+      record repairs the field)."""
+    if rec.get("wire_dtype") == "bf16":
+        err = rec.get("wire_rel_err")
+        return isinstance(err, (int, float)) and err <= budget
+    raced = rec.get("wire_budget")
+    if not isinstance(raced, (int, float)):
+        return True
+    return budget <= raced
 
 
 def resolve_local_backend(shape: Sequence[int], double_prec: bool = False,
@@ -425,7 +517,7 @@ def unresolved(config) -> bool:
     resolved at plan construction."""
     from .. import params as pm
     return pm.AUTO in (config.fft_backend, config.comm_method,
-                       config.comm_method2)
+                       config.comm_method2, config.wire_dtype)
 
 
 def _race_shape(kind: str, global_size, partition,
@@ -479,8 +571,10 @@ def _resolve_local_fft(cfg, store, key, kind, global_size, partition,
 
 
 def _comm_defaults(cfg):
-    """Clear comm 'auto' markers to the dataclass defaults (used when the
-    plan issues no collectives, or when every raced strategy failed)."""
+    """Clear comm/wire 'auto' markers to the dataclass defaults (used when
+    the plan issues no collectives, or when every raced strategy failed —
+    the wire default is the bit-identical native, never a silent lossy
+    choice)."""
     import dataclasses as dc
 
     from .. import params as pm
@@ -489,6 +583,8 @@ def _comm_defaults(cfg):
         kw["comm_method"] = pm.CommMethod.ALL2ALL
     if cfg.comm_method2 == pm.AUTO:
         kw["comm_method2"] = None
+    if cfg.wire_dtype == pm.AUTO:
+        kw["wire_dtype"] = "native"
     return dc.replace(cfg, **kw) if kw else cfg
 
 
@@ -515,7 +611,7 @@ def _broadcast_comm_hit(folded, base):
     comms = (pm.CommMethod.ALL2ALL, pm.CommMethod.PEER2PEER)
     sends = _send_encoding()
     if folded is None:
-        vec = np.full(6, -1, dtype=np.int64)
+        vec = np.full(7, -1, dtype=np.int64)
     else:
         vec = np.asarray([
             1,
@@ -526,6 +622,7 @@ def _broadcast_comm_hit(folded, base):
             sends.index(folded.send_method),
             (-1 if folded.streams_chunks is None
              else int(folded.streams_chunks)),
+            _WIRE_CONCRETE.index(folded.wire_dtype),
         ], dtype=np.int64)
     vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
     if int(vec[0]) != 1:
@@ -537,7 +634,8 @@ def _broadcast_comm_hit(folded, base):
         comm_method2=None if vec[2] < 0 else comms[int(vec[2])],
         opt=int(vec[3]),
         send_method=sends[int(vec[4])], send_method2=None,
-        streams_chunks=None if vec[5] < 0 else int(vec[5]))
+        streams_chunks=None if vec[5] < 0 else int(vec[5]),
+        wire_dtype=_WIRE_CONCRETE[int(vec[6])])
 
 
 def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
@@ -554,7 +652,11 @@ def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
         return _comm_defaults(cfg)
     # "auto" owns the whole comm x send x opt x chunks choice (params.py
     # contract): hits fold and winners apply onto a SYNC-normalized base,
-    # never onto an explicit send_method the race did not measure.
+    # never onto an explicit send_method the race did not measure. A
+    # wire_dtype="auto" riding along normalizes to native here and is
+    # raced as the wire axis of the same comm race (race_wire), so one
+    # race — and one stored record — owns both choices.
+    race_wire = cfg.wire_dtype == pm.AUTO
     norm_base = dc.replace(_comm_defaults(cfg),
                            send_method=pm.SendMethod.SYNC,
                            send_method2=None, streams_chunks=None)
@@ -563,6 +665,25 @@ def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
     if rec is not None:
         try:
             folded = _fold_comm_rec(norm_base, rec)
+            if race_wire and not rec.get("wire_raced"):
+                # The record predates a wire race the caller delegated
+                # (its native wire never competed against the compressed
+                # twin): an ordinary miss, re-raced with the wire axis.
+                folded = None
+            elif race_wire and not _wire_hit_within_budget(
+                    rec, cfg.resolved_wire_budget()):
+                # Recorded bf16 winner, but its measured error exceeds
+                # THIS caller's (tighter) budget: re-race under it.
+                folded = None
+            elif not race_wire \
+                    and folded.wire_dtype != norm_base.wire_dtype:
+                # The record's comm/send/opt winner was raced under a
+                # DIFFERENT wire encoding than the caller's explicit one;
+                # its ranking may not transfer (compression changes the
+                # exchange bytes the race compared), and a fold must
+                # reproduce a program the race actually timed. Re-race at
+                # the caller's wire — the new record then carries it.
+                folded = None
         except (KeyError, TypeError, ValueError):
             folded = None  # stale record: re-measure
     if jax.process_count() > 1:
@@ -577,12 +698,84 @@ def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
                                   mesh=mesh, sequence=sequence,
                                   iterations=_COMM_ITERATIONS,
                                   warmup=_COMM_WARMUP, dims=dims,
-                                  transform=transform, race_send=True)
+                                  transform=transform, race_send=True,
+                                  race_wire=race_wire)
         cfg = at.apply_best_comm(ranked, norm_base)
     except Exception:  # noqa: BLE001 — degrade to defaults, never error
         return _comm_defaults(cfg)
     if store:
         store.record(key, "comm", comm_record(ranked[0], base))
+    return cfg
+
+
+def _broadcast_wire_hit(folded, base):
+    """Process 0's wire hit/miss decision, agreed everywhere (the wire
+    race times collective plans, so a per-host hit/miss split deadlocks —
+    same contract as ``_broadcast_comm_hit``)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    code = (-1 if folded is None
+            else _WIRE_CONCRETE.index(folded.wire_dtype))
+    code = int(multihost_utils.broadcast_one_to_all(np.int64(code)))
+    if code < 0:
+        return None
+    import dataclasses as dc
+    return dc.replace(base, wire_dtype=_WIRE_CONCRETE[code])
+
+
+def _resolve_wire(cfg, store, key, kind, global_size, partition, mesh,
+                  sequence, transform, dims, variant):
+    """Resolve ``wire_dtype="auto"`` when the comm choice is EXPLICIT
+    (comm "auto" resolves both axes in one race — ``_resolve_comm``):
+    wisdom ``wire``-slot hit -> reuse; miss -> race native vs bf16 on the
+    caller's fixed rendering under the error budget
+    (``autotune_wire``) and record; plans without an exchange -> native."""
+    import dataclasses as dc
+
+    import jax
+
+    from .. import params as pm
+
+    single = partition.num_ranks == 1 or (kind == "batched2d"
+                                          and variant == "batch")
+    if single or dims < 2:
+        return dc.replace(cfg, wire_dtype="native")
+    base = dc.replace(cfg, wire_dtype="native")
+    folded = None
+    rec = store.lookup(key, "wire") if store else None
+    if rec is not None:
+        try:
+            folded = _fold_wire_rec(base, rec)
+            if not _wire_hit_within_budget(rec,
+                                           cfg.resolved_wire_budget()):
+                # Recorded bf16 winner over THIS caller's (tighter)
+                # budget: re-race under it (budget is not in the key).
+                folded = None
+        except (KeyError, TypeError, ValueError):
+            folded = None  # stale record: re-measure
+    if jax.process_count() > 1:
+        folded = _broadcast_wire_hit(folded, base)
+    if folded is not None:
+        return folded
+    from ..testing import autotune as at
+    try:
+        ranked = at.autotune_wire(kind, global_size, partition, base,
+                                  mesh=mesh, sequence=sequence,
+                                  iterations=_COMM_ITERATIONS,
+                                  warmup=_COMM_WARMUP, dims=dims,
+                                  transform=transform)
+        best = ranked[0]
+        if not best.ok:
+            return base
+        # Fold ONLY the wire axis (apply_best_comm would also fold the
+        # candidate's mirrored comm/send fields, clobbering an explicit
+        # send_method2 the wire-only race never measured differently).
+        cfg = dc.replace(base, wire_dtype=best.wire or "native")
+    except Exception:  # noqa: BLE001 — degrade to native, never error
+        return base
+    if store:
+        store.record(key, "wire",
+                     wire_record(best, base.resolved_wire_budget()))
     return cfg
 
 
@@ -615,6 +808,7 @@ def _agree_across_processes(cfg):
         int(cfg.opt),
         sends.index(cfg.send_method),
         -1 if cfg.streams_chunks is None else int(cfg.streams_chunks),
+        _WIRE_CONCRETE.index(cfg.wire_dtype),
     ], dtype=np.int64)
     vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
     return dc.replace(
@@ -626,23 +820,28 @@ def _agree_across_processes(cfg):
         comm_method2=None if vec[4] < 0 else comms[int(vec[4])],
         opt=int(vec[5]),
         send_method=sends[int(vec[6])],
-        streams_chunks=None if vec[7] < 0 else int(vec[7]))
+        streams_chunks=None if vec[7] < 0 else int(vec[7]),
+        wire_dtype=_WIRE_CONCRETE[int(vec[8])])
 
 
 def resolve_config(kind: str, global_size, partition, config=None, *,
                    mesh=None, sequence=None, transform: str = "r2c",
                    dims: int = 3, variant: Optional[str] = None):
     """Resolve a Config's ``fft_backend="auto"`` / ``comm_method="auto"``
-    markers into measured concrete values: wisdom hit -> reuse silently;
-    miss -> bounded race (accuracy-gated by the underlying autotuners) and
-    record; no usable store -> race without recording. Configs without an
-    'auto' marker pass through untouched — the zero-cost common case every
-    plan constructor calls."""
+    / ``wire_dtype="auto"`` markers into measured concrete values: wisdom
+    hit -> reuse silently; miss -> bounded race (accuracy-gated by the
+    underlying autotuners; the wire race additionally by
+    ``wire_error_budget``) and record; no usable store -> race without
+    recording. Configs without an 'auto' marker pass through untouched —
+    the zero-cost common case every plan constructor calls. A wire "auto"
+    rides the comm race (one record) when comm is "auto" too, and runs
+    the dedicated wire-only race (``wire`` slot) when comm is explicit."""
     from .. import params as pm
     cfg = config if config is not None else pm.Config()
     wants_fft = cfg.fft_backend == pm.AUTO
     wants_comm = pm.AUTO in (cfg.comm_method, cfg.comm_method2)
-    if not (wants_fft or wants_comm):
+    wants_wire = cfg.wire_dtype == pm.AUTO
+    if not (wants_fft or wants_comm or wants_wire):
         return cfg
     store = store_for_config(cfg)
     key = plan_key(kind, global_size.shape, cfg.double_prec, partition,
@@ -653,6 +852,10 @@ def resolve_config(kind: str, global_size, partition, config=None, *,
         cfg = _resolve_local_fft(cfg, store, key, kind, global_size,
                                  partition, variant)
     if wants_comm:
+        # Owns the wire axis too when it is "auto" (race_wire).
         cfg = _resolve_comm(cfg, store, key, kind, global_size, partition,
+                            mesh, sequence, transform, dims, variant)
+    elif wants_wire:
+        cfg = _resolve_wire(cfg, store, key, kind, global_size, partition,
                             mesh, sequence, transform, dims, variant)
     return _agree_across_processes(cfg)

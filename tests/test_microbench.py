@@ -134,15 +134,18 @@ def test_reference_cli_fraction_gate(devices, capsys):
 def test_async_collective_counts_text_contract():
     """The overlap detector counts op INSTANCES per form: the plain op
     must not swallow its async -start form (or vice versa), and
-    async_total sums only the starts."""
+    async_total sums only the starts. ``convert`` counts the wire layer's
+    encode/decode casts (tests/test_wire.py asserts the compressed-ring
+    gate on it)."""
     txt = """
   %a = f32[8] all-to-all(x), replica_groups={}
   %b = f32[8] all-to-all-start(x)
   %c = f32[8] collective-permute(x), source_target_pairs={{0,1}}
   %d = f32[8] collective-permute(y), source_target_pairs={{1,0}}
   %e = f32[8] collective-permute-start(z)
+  %f = bf16[8] convert(w)
 """
     counts = mb.async_collective_counts(txt)
     assert counts == {"all_to_all": 1, "all_to_all_start": 1,
                       "collective_permute": 2, "collective_permute_start": 1,
-                      "async_total": 2}
+                      "async_total": 2, "convert": 1}

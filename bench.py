@@ -99,6 +99,50 @@ def _flops_roundtrip(n: int) -> float:
 # children (each runs in its own process; last stdout line is its JSON)
 # ---------------------------------------------------------------------------
 
+def _maybe_profile(tag: str):
+    """``jax.profiler.trace`` over a child's measurement region when the
+    parent was launched with ``--profile-dir`` (forwarded via
+    ``DFFT_BENCH_PROFILE_DIR``), so benchmark runs produce device traces
+    carrying the obs span names (``dfft:*`` TraceAnnotations). A
+    nullcontext otherwise — and on ANY profiler failure, because a broken
+    trace backend must never cost a measurement."""
+    import contextlib
+    d = os.environ.get("DFFT_BENCH_PROFILE_DIR", "")
+    if not d:
+        return contextlib.nullcontext()
+    try:
+        import jax
+        return jax.profiler.trace(os.path.join(d, tag))
+    except Exception:  # noqa: BLE001 — tracing is an optional extra
+        return contextlib.nullcontext()
+
+
+def _enter_profile(tag: str):
+    """Start the child's profiler trace; returns the ENTERED context (to
+    ``__exit__`` before the final print) or None when tracing is off or
+    the trace failed to start — start failures (unwritable dir, nested
+    trace) must never cost the measurement they decorate."""
+    try:
+        prof = _maybe_profile(tag)
+        prof.__enter__()
+        return prof
+    except Exception:  # noqa: BLE001 — same contract as _maybe_profile
+        return None
+
+
+def _fold_obs_metrics(out: dict) -> None:
+    """Attach the obs metrics snapshot (wisdom hits/misses, race cells,
+    wire bytes, HLO census gauges) to a child's JSON record when anything
+    was counted; the parent folds it into BENCH_DETAILS.json."""
+    try:
+        from distributedfft_tpu import obs
+        snap = obs.metrics.snapshot()
+        if snap["counters"] or snap["gauges"]:
+            out["obs_metrics"] = snap
+    except Exception:  # noqa: BLE001 — metrics are an optional extra
+        pass
+
+
 def _child_probe() -> int:
     """Claim the default platform, touch one device, exit cleanly."""
     import jax
@@ -126,6 +170,7 @@ def _child_tpu(deadline_s: int) -> int:
     signal.alarm(deadline_s)
 
     out = {"sizes": {}, "partial": False}
+    prof = None
     try:
         import numpy as np
 
@@ -200,6 +245,10 @@ def _child_tpu(deadline_s: int) -> int:
             raise ValueError(f"DFFT_BENCH_MODE must be roundtrip/forward/"
                              f"inverse, got {mode!r}")
         out["mode"] = mode
+        # Device trace of the measurement region (--profile-dir). Entered
+        # manually: the try-block structure predates it, and the exit must
+        # run on the partial/error paths too (see below, pre-print).
+        prof = _enter_profile("tpu")
         for size_idx, n in enumerate(sizes):
             # Smaller cubes need a longer chain for the (K-1) iterations of
             # work to dominate the tunnel's tens-of-ms run-to-run constant
@@ -398,6 +447,12 @@ def _child_tpu(deadline_s: int) -> int:
     except Exception as e:  # noqa: BLE001 — report, never hang the driver
         out["partial"] = True
         out["error"] = f"{type(e).__name__}: {e}"
+    if prof is not None:
+        try:
+            prof.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001 — flushing a trace is best-effort
+            pass
+    _fold_obs_metrics(out)
     signal.alarm(0)
     print(json.dumps(out))
     return 0
@@ -488,6 +543,7 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
     from distributedfft_tpu.testing import chaintimer, microbench
 
     out = {}
+    prof = None
     # Internal deadline mirroring _child_tpu: _child_mesh prints its
     # JSON once at exit, so without this a parent kill at
     # MESH_TIMEOUT_S discards the already-measured core gate metrics
@@ -498,6 +554,7 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
     signal.signal(signal.SIGALRM, _handler)
     signal.alarm(max(30, deadline_s - 20))
     try:
+        prof = _enter_profile("mesh")
         # DFFT_BENCH_MESH_N: test hook shrinking the mesh-child volume so
         # the full parent pipeline is runnable in CI time (default =
         # BASELINE 256).
@@ -736,6 +793,12 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
     except Exception as e:  # noqa: BLE001 — still print what was measured
         out["partial"] = True
         out["error"] = f"{type(e).__name__}: {e}"
+    if prof is not None:
+        try:
+            prof.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001 — flushing a trace is best-effort
+            pass
+    _fold_obs_metrics(out)
     signal.alarm(0)
     print(json.dumps(out))
     return 0
@@ -1134,6 +1197,12 @@ def main() -> int:
         if mesh.get("mesh_pipeline_sequences"):
             result["mesh_pipeline_sequences"] = \
                 mesh["mesh_pipeline_sequences"]
+        if mesh.get("obs_metrics"):
+            # Obs registry snapshot of the mesh child (wisdom hits/misses,
+            # race cells, per-shard wire bytes, HLO census gauges).
+            result["obs_metrics_mesh"] = mesh["obs_metrics"]
+    if (tpu or {}).get("obs_metrics"):
+        result["obs_metrics_tpu"] = tpu["obs_metrics"]
     if (tpu or {}).get("partial"):
         diags.append(f"tpu partial: {tpu.get('error')}")
     if diags:
@@ -1166,6 +1235,19 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    # --profile-dir DIR (parent only): forwarded to the children via
+    # DFFT_BENCH_PROFILE_DIR, so their measurement regions run inside a
+    # jax.profiler trace and the device timelines carry the obs span
+    # annotations. Parsed by hand — the parent must stay argparse/jax-free
+    # and the flag must not disturb the --child dispatch below.
+    if "--profile-dir" in sys.argv:
+        _i = sys.argv.index("--profile-dir")
+        if _i + 1 >= len(sys.argv):
+            print("bench.py: --profile-dir needs a directory argument",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["DFFT_BENCH_PROFILE_DIR"] = sys.argv[_i + 1]
+        del sys.argv[_i:_i + 2]
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         name = sys.argv[2]
         if name == "probe":

@@ -71,7 +71,19 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                     help="write a jax.profiler trace of the testcase run to "
                          "this directory (view with TensorBoard / Perfetto) — "
                          "the deep-dive complement to the per-phase Timer "
-                         "CSVs, SURVEY §5 tracing")
+                         "CSVs, SURVEY §5 tracing; obs span names appear on "
+                         "the trace as dfft:* annotations")
+    ap.add_argument("--obs", action="store_true",
+                    help="observability console: print wisdom-provenance "
+                         "one-liners (hit|miss|migrated) as they happen and "
+                         "the obs metrics snapshot after the run (the "
+                         "structured event log is separate: $DFFT_OBS_DIR / "
+                         "--obs-dir)")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="write the structured JSONL event log (spans + "
+                         "events; see README 'Observability') under this "
+                         "directory — same effect as $DFFT_OBS_DIR, default "
+                         "off")
     ap.add_argument("--multihost", action="store_true",
                     help="join the multi-controller runtime (one process per "
                          "host; rendezvous via DFFT_COORDINATOR / "
@@ -273,12 +285,36 @@ def run_testcase(plan, args, dims=None) -> int:
     if "mean_ms" in result:
         print(f"Run complete: {result['mean_ms']:.4f} ms "
               f"(mean over {args.iterations} iterations)")
+    print_obs_snapshot(args)
     return 0
+
+
+def setup_obs(args) -> None:
+    """Apply the CLI observability surface (--obs / --obs-dir) before any
+    plan is constructed, so provenance notices and build spans from the
+    very first resolution are captured."""
+    from .. import obs
+    if getattr(args, "obs_dir", None):
+        obs.enable(args.obs_dir)
+    if getattr(args, "obs", False):
+        obs.enable_console()
+
+
+def print_obs_snapshot(args) -> None:
+    """The --obs epilogue: one compact JSON line of the metrics registry."""
+    if not getattr(args, "obs", False):
+        return
+    import json as _json
+
+    from .. import obs
+    print("obs metrics: "
+          + _json.dumps(obs.metrics.snapshot(), sort_keys=True))
 
 
 def setup_backend(args) -> None:
     """Apply device emulation / multi-host rendezvous before any jax backend
     use. Must be called before the first jax device query."""
+    setup_obs(args)
     import jax
     if args.emulate_devices:
         if getattr(args, "multihost", False):

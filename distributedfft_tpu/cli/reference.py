@@ -23,7 +23,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .common import add_common_args, maybe_profile, setup_backend
+from .common import (add_common_args, maybe_profile, print_obs_snapshot,
+                     setup_backend)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -85,10 +86,13 @@ def main(argv=None) -> int:
             key = wisdom.local_key(shape, args.double_prec)
             if store.record(key, "local_fft", wisdom.local_fft_record(best)):
                 print(f"wisdom: winner recorded -> {store.path}")
+        print_obs_snapshot(args)
         return 0
 
     with maybe_profile(args):
-        return _dispatch(args, shape, dtype, it, wu)
+        rc = _dispatch(args, shape, dtype, it, wu)
+    print_obs_snapshot(args)
+    return rc
 
 
 def _dispatch(args, shape, dtype, it, wu) -> int:

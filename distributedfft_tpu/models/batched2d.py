@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import obs
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
@@ -125,6 +126,13 @@ class Batched2DFFTPlan:
         self._inv = None
         self._fwd_pure = None
         self._inv_pure = None
+        obs.event("plan.created", kind="batched2d", shard=shard,
+                  transform=transform, shape=[batch, nx, ny], ranks=P,
+                  batch_chunk=batch_chunk,
+                  comm=self.config.comm_method.value,
+                  send=self.config.send_method.value, opt=self.config.opt,
+                  wire=self.config.wire_dtype,
+                  backend=self.config.fft_backend)
 
     # -- shapes ------------------------------------------------------------
 
@@ -244,12 +252,14 @@ class Batched2DFFTPlan:
         return fn
 
     def _build(self, forward: bool):
-        pure, in_spec, out_spec = self._build_pure(forward)
-        if self.mesh is None:
-            return jax.jit(pure)
-        return jax.jit(pure,
-                       in_shardings=NamedSharding(self.mesh, in_spec),
-                       out_shardings=NamedSharding(self.mesh, out_spec))
+        with obs.span("plan.build", kind="batched2d", shard=self.shard,
+                      direction="forward" if forward else "inverse"):
+            pure, in_spec, out_spec = self._build_pure(forward)
+            if self.mesh is None:
+                return jax.jit(pure)
+            return jax.jit(pure,
+                           in_shardings=NamedSharding(self.mesh, in_spec),
+                           out_shardings=NamedSharding(self.mesh, out_spec))
 
     def _build_pure(self, forward: bool):
         """(pure_fn, in_spec, out_spec) — the specs travel with the

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import obs
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
@@ -115,6 +116,14 @@ class PencilFFTPlan(DistFFTPlan):
         # compiled-callable caches keyed by dims
         self._r2c_d: Dict[int, object] = {}
         self._c2r_d: Dict[int, object] = {}
+        obs.event("plan.created", kind="pencil", transform=transform,
+                  shape=list(g.shape), grid=[self.p1, self.p2],
+                  comm=self.config.comm_method.value,
+                  comm2=self.config.resolved_comm2().value,
+                  send=self.config.send_method.value,
+                  send2=self.config.resolved_snd2().value,
+                  opt=self.config.opt, wire=self.config.wire_dtype,
+                  backend=self.config.fft_backend)
 
     # -- shapes ------------------------------------------------------------
 
@@ -442,14 +451,18 @@ class PencilFFTPlan(DistFFTPlan):
         return segments, start
 
     def _build_r2c_d(self, dims: int):
-        if self.fft3d:
-            return self._fft3d_r2c_d(dims)
-        return self._compile(*self._fwd_segments(dims))
+        with obs.span("plan.build", kind="pencil", direction="forward",
+                      dims=dims):
+            if self.fft3d:
+                return self._fft3d_r2c_d(dims)
+            return self._compile(*self._fwd_segments(dims))
 
     def _build_c2r_d(self, dims: int):
-        if self.fft3d:
-            return self._fft3d_c2r_d(dims)
-        return self._compile(*self._inv_segments(dims))
+        with obs.span("plan.build", kind="pencil", direction="inverse",
+                      dims=dims):
+            if self.fft3d:
+                return self._fft3d_c2r_d(dims)
+            return self._compile(*self._inv_segments(dims))
 
     def forward_fn(self, dims: int = 3):
         """Pure forward pipeline (``DistFFTPlan.forward_fn`` contract);

@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import obs
 from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
@@ -141,6 +142,12 @@ class SlabFFTPlan(DistFFTPlan):
             out = [None, None, None]
             out[self._seq.split_axis] = SLAB_AXIS
             self._out_spec = PartitionSpec(*out)
+        obs.event("plan.created", kind="slab", sequence=self.sequence.value,
+                  transform=transform, shape=list(g.shape), ranks=P,
+                  comm=self.config.comm_method.value,
+                  send=self.config.send_method.value, opt=self.config.opt,
+                  wire=self.config.wire_dtype,
+                  backend=self.config.fft_backend)
 
     # -- shapes & size tables (reference getInSize/getOutSize family,
     #    include/mpicufft.hpp:66-79) --------------------------------------
@@ -537,18 +544,24 @@ class SlabFFTPlan(DistFFTPlan):
     # -- pipeline builders -------------------------------------------------
 
     def _build_r2c(self):
-        if self.fft3d:
-            return (self._fft3d_c2c(forward=True) if self.transform == "c2c"
-                    else self._fft3d_r2c())
-        return self._assemble(self._fwd_parts(), self._in_spec, self._out_spec,
-                              self.config.comm_method, forward=True)
+        with obs.span("plan.build", kind="slab", direction="forward",
+                      sequence=self.sequence.value):
+            if self.fft3d:
+                return (self._fft3d_c2c(forward=True)
+                        if self.transform == "c2c" else self._fft3d_r2c())
+            return self._assemble(self._fwd_parts(), self._in_spec,
+                                  self._out_spec, self.config.comm_method,
+                                  forward=True)
 
     def _build_c2r(self):
-        if self.fft3d:
-            return (self._fft3d_c2c(forward=False) if self.transform == "c2c"
-                    else self._fft3d_c2r())
-        return self._assemble(self._inv_parts(), self._out_spec, self._in_spec,
-                              self.config.comm_method, forward=False)
+        with obs.span("plan.build", kind="slab", direction="inverse",
+                      sequence=self.sequence.value):
+            if self.fft3d:
+                return (self._fft3d_c2c(forward=False)
+                        if self.transform == "c2c" else self._fft3d_c2r())
+            return self._assemble(self._inv_parts(), self._out_spec,
+                                  self._in_spec, self.config.comm_method,
+                                  forward=False)
 
     def _assemble(self, parts, in_spec, out_spec, comm: pm.CommMethod,
                   forward: bool = True):

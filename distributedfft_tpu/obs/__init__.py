@@ -1,0 +1,41 @@
+"""Structured observability: span tracing, metrics registry, plan explain.
+
+Three coordinated pieces (none of which may perturb a compiled program —
+the zero-overhead-when-off contract is pinned by ``tests/test_obs.py``):
+
+* ``obs.span("plan.build") / obs.event / obs.notice`` — host-side span
+  tracing into a per-run JSONL event log under ``$DFFT_OBS_DIR`` (default
+  off), with ``jax.profiler.TraceAnnotation`` mirroring the names into
+  TensorBoard/Perfetto traces (``tracing.py``).
+* ``obs.metrics`` — process-global named counters/gauges with a
+  ``snapshot()`` dict that ``bench.py`` folds into ``BENCH_DETAILS.json``
+  and the CLIs print under ``--obs`` (``metrics.py``).
+* ``dfft-explain`` — resolved-plan diagnostics without executing the FFT
+  (``explain.py``; registered in pyproject.toml).
+
+This package imports no jax at module import time, so ``params``-level
+(device-free) usage stays possible.
+"""
+
+from . import metrics
+from .tracing import (ENV_VAR, console_enabled, disable, disable_console,
+                      enable, enable_console, enabled, event, event_log_path,
+                      notice, obs_dir, reset_enablement, span, validate_event,
+                      validate_events_dir, validate_events_file)
+
+__all__ = [
+    "ENV_VAR", "console_enabled", "disable", "disable_console", "enable",
+    "enable_console", "enabled", "event", "event_log_path", "metrics",
+    "notice", "obs_dir", "reset_enablement", "snapshot", "reset", "span",
+    "validate_event", "validate_events_dir", "validate_events_file",
+]
+
+
+def snapshot():
+    """Shorthand for ``metrics.snapshot()``."""
+    return metrics.snapshot()
+
+
+def reset():
+    """Shorthand for ``metrics.reset()`` (does not touch enablement)."""
+    metrics.reset()

@@ -1,0 +1,438 @@
+"""``dfft-explain`` — resolved-plan diagnostics WITHOUT executing the FFT.
+
+After wisdom ("auto" resolution), the ring rendering and the wire layer, a
+plan's actual shape — which collective program it builds, how many bytes
+cross the wire, where its config values came from — is decided at
+construction and was previously visible only by reading code or timing
+runs. This executable answers "why did the plan do X" for a given
+config + shape:
+
+* decomposition: kind, partition/mesh, padded shapes, partition specs;
+* the per-axis FFT sequence each pipeline stage runs;
+* the resolved exchange rendering (default / realigned opt1 / ring /
+  streams / GSPMD) per transpose;
+* wire dtype and predicted wire bytes per exchange (``wire_nbytes`` over
+  the exact padded payload the plan exchanges);
+* wisdom provenance: store path, on-disk schema version, hit/miss per
+  consulted slot, the recorded winners and when they were recorded
+  (lookup-only — a miss is REPORTED, never raced, so explain runs no
+  measurement);
+* HLO collective census: the forward program is lowered and compiled
+  (never executed) and ``microbench.async_collective_counts`` reports the
+  collective / async-start / convert instance counts;
+* roofline expectation (``evalkit/roofline.py``): nominal FFT flops, the
+  MXU flops the matmul backend would issue, and the v5e-effective-peak
+  ideal time.
+
+Examples::
+
+    dfft-explain --kind slab   -nx 256 -ny 256 -nz 256 -p 8 --emulate-devices 8
+    dfft-explain --kind pencil -nx 64 -ny 64 -nz 64 -p1 2 -p2 4 \
+        -snd1 Ring --emulate-devices 8
+    dfft-explain --kind batched -nx 4096 -ny 4096 -nz 64 --shard x -p 8 \
+        -wire bf16 --emulate-devices 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="dfft-explain", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--kind", choices=("slab", "pencil", "batched"),
+                    default="slab", help="plan family to explain")
+    ap.add_argument("--input-dim-x", "-nx", type=int, required=True)
+    ap.add_argument("--input-dim-y", "-ny", type=int, required=True)
+    ap.add_argument("--input-dim-z", "-nz", type=int, required=True,
+                    help="(batched: the batch count, like dfft-batched)")
+    ap.add_argument("--partitions", "-p", type=int, default=0,
+                    help="slab/batched mesh width (default: all devices)")
+    ap.add_argument("--partition1", "-p1", type=int, default=0,
+                    help="pencil grid rows")
+    ap.add_argument("--partition2", "-p2", type=int, default=0,
+                    help="pencil grid cols")
+    ap.add_argument("--sequence", "-s", default="ZY_Then_X",
+                    help="slab sequence")
+    ap.add_argument("--shard", default="batch", choices=("batch", "x"),
+                    help="batched2d decomposed axis")
+    ap.add_argument("--fft-dim", "-f", type=int, default=3,
+                    choices=(1, 2, 3), help="pencil partial-transform depth")
+    ap.add_argument("--comm-method", "-comm", "-comm1", dest="comm_method",
+                    default="All2All")
+    ap.add_argument("--comm-method2", "-comm2", default=None)
+    ap.add_argument("--send-method", "-snd", "-snd1", dest="send_method",
+                    default="Sync")
+    ap.add_argument("--send-method2", "-snd2", default=None)
+    ap.add_argument("--opt", "-o", type=int, default=0, choices=(0, 1))
+    ap.add_argument("--streams-chunks", type=int, default=None)
+    ap.add_argument("--wire-dtype", "-wire", default="native",
+                    choices=("native", "bf16", "auto"))
+    ap.add_argument("--wire-error-budget", type=float, default=None)
+    ap.add_argument("--fft-backend", default="xla")
+    ap.add_argument("--double_prec", "-d", action="store_true")
+    ap.add_argument("--c2c", action="store_true",
+                    help="explain the C2C transform instead of R2C")
+    ap.add_argument("--wisdom", default=None, metavar="PATH")
+    ap.add_argument("--no-wisdom", action="store_true")
+    ap.add_argument("--emulate-devices", type=int, default=0,
+                    help="force N virtual CPU devices (0 = real backend)")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip the HLO collective census (no XLA compile; "
+                         "everything else is pure bookkeeping)")
+    ap.add_argument("--obs", action="store_true",
+                    help="print the obs metrics snapshot after the report")
+    ap.add_argument("--obs-dir", default=None,
+                    help="write the obs event log here (same as "
+                         "$DFFT_OBS_DIR)")
+    return ap
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f} GiB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.2f} KiB"
+    return f"{n} B"
+
+
+def _rendering(comm, send, opt, p: int) -> str:
+    """One-line resolved rendering of a single transpose."""
+    from .. import params as pm
+    if send is pm.SendMethod.RING:
+        steps = f"{p - 1} distinct lax.ppermute step" \
+            + ("s" if p > 2 else "")
+        return (f"ring — {steps} (owns the rendering regardless of comm; "
+                "per-block FFTs pipelined where axis roles allow)")
+    layout = "realigned (opt1 pack, pure exchange)" if opt == 1 \
+        else "default layout"
+    if comm is pm.CommMethod.ALL2ALL:
+        base = f"explicit shard_map lax.all_to_all, {layout}"
+        if send is pm.SendMethod.STREAMS:
+            return base + " — STREAMS: chunked into independent piece chains"
+        return base
+    base = f"GSPMD (Peer2Peer) stage-boundary reshard, {layout}"
+    if send is pm.SendMethod.STREAMS:
+        return base + (" — STREAMS piece reshards (GSPMD re-fuses them "
+                       "into ONE collective; honest no-op, see OVERLAP.md)")
+    return base
+
+
+def _wire_lines(shapes, cdt, cfg) -> list:
+    """Wire block: per-exchange payload shape + wire bytes."""
+    import numpy as np
+
+    from ..parallel.transpose import wire_itemsize, wire_nbytes
+    wire = cfg.wire_dtype
+    lines = [f"  dtype: {wire}  "
+             f"({wire_itemsize(cdt, wire)} B/elem on the wire vs "
+             f"{np.dtype(cdt).itemsize} B logical)"]
+    for label, shape in shapes:
+        wb = wire_nbytes(shape, cdt, wire)
+        lb = wire_nbytes(shape, cdt, "native")
+        extra = "" if wire == "native" else \
+            f" (native would be {_fmt_bytes(lb)})"
+        lines.append(f"  {label}: payload {tuple(shape)} -> "
+                     f"wire_nbytes {_fmt_bytes(wb)}{extra}")
+    if wire == "bf16":
+        lines.append(f"  lossy: ~2e-3 max rel err per crossing; budget "
+                     f"{cfg.resolved_wire_budget():.0e} "
+                     "(README 'wire dtype')")
+    return lines
+
+
+def _wisdom_lines(prov) -> list:
+    lines = []
+    if prov["store_path"] is None:
+        lines.append("  store: none configured (--wisdom / $DFFT_WISDOM "
+                     "unset, or --no-wisdom)")
+    else:
+        v = prov["store_version"]
+        vs = "absent on disk" if v is None else f"on-disk version {v}"
+        lines.append(f"  store: {prov['store_path']} ({vs})")
+    if not prov["slots"]:
+        lines.append("  slots: none consulted (no 'auto' Config fields)")
+        return lines
+    for slot, info in prov["slots"].items():
+        status = info["status"]
+        if status == "hit":
+            rec = info.get("record") or {}
+            when = rec.get("recorded_at", "recorded_at unknown")
+            detail = ", ".join(f"{k}={rec[k]}" for k in sorted(rec)
+                               if k != "recorded_at")
+            lines.append(f"  {slot}: hit ({detail}) [{when}]")
+        elif status == "miss":
+            lines.append(f"  {slot}: miss ({info.get('reason')}) — a real "
+                         "run would race and record; defaults shown below")
+        else:
+            lines.append(f"  {slot}: {status}")
+    return lines
+
+
+def _roofline_lines(args, kind: str, backend: str) -> list:
+    """Roofline expectation for the explained workload (cube / batched-2D
+    only — the shapes the MAC model covers)."""
+    from ..evalkit import roofline as rl
+    from ..testing.workloads import flops_batched2d, flops_roundtrip_3d
+    nx, ny, nz = args.input_dim_x, args.input_dim_y, args.input_dim_z
+    lines = []
+    if kind == "batched":
+        if nx != ny:
+            return ["  (batched roofline model needs square planes; "
+                    "skipped)"]
+        nominal = flops_batched2d(nz, nx, ny)
+        mxu4 = rl.mxu_flops_batched2d(nz, nx)
+        mxu3 = rl.mxu_flops_batched2d(nz, nx, complex_mults=3)
+        what = f"{nx}^2 x {nz} roundtrip"
+    elif nx == ny == nz:
+        nominal = flops_roundtrip_3d(nx)
+        mxu4 = rl.mxu_flops_roundtrip_3d(nx)
+        mxu3 = rl.mxu_flops_roundtrip_3d(nx, complex_mults=3)
+        what = f"{nx}^3 roundtrip"
+    else:
+        return ["  (MXU MAC model covers cubes and square batched planes "
+                "only; skipped for this shape)"]
+    lines.append(f"  nominal FFT flops ({what}): {nominal / 1e9:.2f} GF "
+                 "(2.5·N·log2 N per direction)")
+    lines.append(f"  matmul-backend MXU flops: {mxu3 / 1e9:.2f}-"
+                 f"{mxu4 / 1e9:.2f} GF (3mm-4mm complex-dot bracket)")
+    peak = rl.effective_peak_tflops("high")
+    ideal_ms = mxu4 / (peak * 1e12) * 1e3
+    lines.append(f"  v5e effective peak @high: {peak:.1f} TFLOPS -> ideal "
+                 f"matmul roundtrip >= {ideal_ms:.2f} ms "
+                 "(100% MXU; backend here: " + backend + ")")
+    return lines
+
+
+def _census_lines(compiled) -> list:
+    from ..testing.microbench import async_collective_counts
+    c = async_collective_counts(compiled)
+    order = ("all_to_all", "all_to_all_start", "collective_permute",
+             "collective_permute_start", "async_total", "convert")
+    return ["  " + "  ".join(f"{k}: {c[k]}" for k in order)]
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .. import obs
+    if args.obs_dir:
+        obs.enable(args.obs_dir)
+    if args.obs:
+        obs.enable_console()
+
+    if args.emulate_devices:
+        from ..parallel.mesh import force_cpu_devices
+        force_cpu_devices(args.emulate_devices)
+
+    import jax
+    import numpy as np
+
+    if args.double_prec:
+        jax.config.update("jax_enable_x64", True)
+
+    from .. import params as pm
+    from ..testing import testcases as tc
+    from ..utils import wisdom
+
+    kind = args.kind
+    transform = "c2c" if args.c2c else "r2c"
+    nx, ny, nz = args.input_dim_x, args.input_dim_y, args.input_dim_z
+    ndev = len(jax.devices())
+    cfg = pm.Config(
+        comm_method=pm.parse_comm_method(args.comm_method),
+        send_method=pm.SendMethod.parse(args.send_method),
+        comm_method2=(pm.parse_comm_method(args.comm_method2)
+                      if args.comm_method2 else None),
+        send_method2=(pm.SendMethod.parse(args.send_method2)
+                      if args.send_method2 else None),
+        opt=args.opt, double_prec=args.double_prec,
+        fft_backend=args.fft_backend,
+        streams_chunks=args.streams_chunks,
+        wire_dtype=pm.parse_wire_dtype(args.wire_dtype),
+        wire_error_budget=args.wire_error_budget,
+        wisdom_path=args.wisdom, use_wisdom=not args.no_wisdom)
+
+    if kind == "pencil":
+        p1 = args.partition1 or 2
+        p2 = args.partition2 or max(1, ndev // p1)
+        partition = pm.PencilPartition(p1, p2)
+        g = pm.GlobalSize(nx, ny, nz)
+        mk_kind, variant, dims = "pencil", None, args.fft_dim
+    elif kind == "batched":
+        p = args.partitions or ndev
+        partition = pm.SlabPartition(p)
+        # Batched size-slot convention: (batch, nx, ny) with -nz = batch.
+        g = pm.GlobalSize(nz, nx, ny)
+        mk_kind, variant, dims = "batched2d", args.shard, 2
+    else:
+        p = args.partitions or ndev
+        partition = pm.SlabPartition(p)
+        g = pm.GlobalSize(nx, ny, nz)
+        mk_kind, variant, dims = "slab", None, 3
+
+    with obs.span("explain", kind=mk_kind, shape=list(g.shape)):
+        # LOOKUP-ONLY resolution: a miss is reported, never raced —
+        # explain must not execute measurement programs.
+        cfg, prov = wisdom.peek_config(
+            mk_kind, g, partition, cfg,
+            sequence=args.sequence if kind == "slab" else None,
+            transform=transform, dims=dims, variant=variant)
+
+        # Build the plan with the fully concrete config (passes through
+        # resolve_config untouched — no race can trigger).
+        if kind == "batched":
+            from ..models.batched2d import Batched2DFFTPlan
+            plan = Batched2DFFTPlan(nz, nx, ny, partition, cfg,
+                                    shard=args.shard, transform=transform)
+        else:
+            plan = tc.make_plan(mk_kind, g, partition, cfg,
+                                sequence=args.sequence, transform=transform,
+                                dims=dims)
+        cfg = plan.config
+
+        platform = jax.devices()[0].platform
+        cdt = np.complex128 if args.double_prec else np.complex64
+        rdt = (cdt if transform == "c2c"
+               else (np.float64 if args.double_prec else np.float32))
+        ranks = partition.num_ranks
+        mesh_desc = (dict(plan.mesh.shape) if plan.mesh is not None
+                     else "single-device (fft3d fallback)")
+
+        out = []
+        out.append(f"dfft-explain: {mk_kind} {g.nx}x{g.ny}x{g.nz} "
+                   f"{transform} over {ranks} rank(s) on {platform} "
+                   f"(mesh {mesh_desc})")
+
+        out.append("decomposition:")
+        out.append(f"  kind: {mk_kind}"
+                   + (f"  sequence: {plan.sequence.value}"
+                      if kind == "slab" else "")
+                   + (f"  shard: {args.shard}" if kind == "batched" else "")
+                   + (f"  dims: {dims}" if kind == "pencil" else ""))
+        in_spec = getattr(plan, "_in_spec", None)
+        out_spec = getattr(plan, "_out_spec", None)
+        out.append(f"  input : logical {tuple(plan.input_shape)}  padded "
+                   f"{tuple(plan.input_padded_shape)}  spec "
+                   f"{in_spec if plan.mesh is not None else '—'}")
+        out.append(f"  output: logical {tuple(plan.output_shape)}  padded "
+                   f"{tuple(plan.output_padded_shape)}  spec "
+                   f"{out_spec if plan.mesh is not None else '—'}")
+
+        out.append("fft sequence:")
+        xshapes = []  # (label, exchanged global payload shape)
+        if kind == "slab":
+            s = plan._seq
+            first = ("C2C" if transform == "c2c" else "R2C") \
+                + f" axis {'xyz'[s.r2c_axis]}"
+            if s.pre_axes:
+                first += " + C2C " + ",".join("xyz"[a] for a in s.pre_axes)
+            out.append(f"  stage 1: {first}")
+            if ranks > 1:
+                out.append(f"  exchange: scatter {'xyz'[s.split_axis]} -> "
+                           "gather x")
+                xshapes.append(("transpose", plan.output_padded_shape))
+            out.append("  stage 2: C2C "
+                       + ",".join("xyz"[a] for a in s.post_axes))
+        elif kind == "pencil":
+            out.append("  stage 1: " + ("C2C z" if transform == "c2c"
+                                        else "R2C z"))
+            if dims >= 2 and ranks > 1:
+                t1_shape = (plan._nx_p1, plan._ny_p2, plan._nzc_p2)
+                out.append("  exchange 1 (p2 axis): scatter z -> gather y")
+                xshapes.append(("transpose 1", t1_shape))
+            if dims >= 2:
+                out.append("  stage 2: C2C y")
+            if dims >= 3 and ranks > 1:
+                t2_shape = (plan._nx_p1, plan._ny_p1, plan._nzc_p2)
+                out.append("  exchange 2 (p1 axis): scatter y -> gather x")
+                xshapes.append(("transpose 2", t2_shape))
+            if dims >= 3:
+                out.append("  stage 3: C2C x")
+        else:
+            out.append("  stage 1: " + ("C2C y" if transform == "c2c"
+                                        else "R2C y") + " (per plane)")
+            if args.shard == "x" and ranks > 1:
+                out.append("  exchange: scatter spectral y -> gather x")
+                xshapes.append(("transpose",
+                                (plan._batch_pad, plan._nx_pad,
+                                 plan._nys_pad)))
+                out.append("  stage 2: C2C x (per plane)")
+            else:
+                out.append("  stage 2: C2C x (per plane; batch sharding "
+                           "issues no collectives)")
+
+        out.append("rendering:")
+        if ranks == 1 or (kind == "batched" and args.shard == "batch"):
+            out.append("  no exchange: "
+                       + ("single-device fft3d fallback" if ranks == 1
+                          else "embarrassingly parallel batch sharding "
+                               "(zero collectives)"))
+        elif kind == "pencil":
+            out.append(f"  transpose 1: comm {cfg.comm_method.value} snd "
+                       f"{cfg.send_method.value} -> "
+                       + _rendering(cfg.comm_method, cfg.send_method,
+                                    cfg.opt, plan.p2))
+            if dims >= 3:
+                out.append(f"  transpose 2: comm "
+                           f"{cfg.resolved_comm2().value} snd "
+                           f"{cfg.resolved_snd2().value} -> "
+                           + _rendering(cfg.resolved_comm2(),
+                                        cfg.resolved_snd2(), cfg.opt,
+                                        plan.p1))
+        else:
+            out.append(f"  comm {cfg.comm_method.value} snd "
+                       f"{cfg.send_method.value} opt {cfg.opt} -> "
+                       + _rendering(cfg.comm_method, cfg.send_method,
+                                    cfg.opt, ranks))
+        out.append(f"  local FFT backend: {cfg.fft_backend}"
+                   + (f" (mxu_precision={cfg.mxu_precision}, "
+                      f"mxu_direct_max={cfg.mxu_direct_max})"
+                      if cfg.fft_backend.startswith("matmul") else ""))
+
+        out.append("wire:")
+        if xshapes:
+            out.extend(_wire_lines(xshapes, cdt, cfg))
+        else:
+            out.append("  no exchange -> nothing on the wire")
+
+        out.append("wisdom:")
+        out.extend(_wisdom_lines(prov))
+
+        if not args.no_compile:
+            out.append("hlo census (forward program, compiled, "
+                       "NOT executed):")
+            try:
+                with obs.span("explain.compile", kind=mk_kind):
+                    if kind == "pencil":
+                        fn = plan._build_r2c_d(dims)
+                    elif kind == "batched":
+                        fn = plan._build(forward=True)
+                    else:
+                        fn = plan._build_r2c()
+                    arg = jax.ShapeDtypeStruct(
+                        tuple(plan.input_padded_shape), rdt)
+                    compiled = fn.lower(arg).compile()
+                out.extend(_census_lines(compiled))
+            except Exception as e:  # noqa: BLE001 — census is best-effort
+                out.append(f"  unavailable: {type(e).__name__}: {e}")
+
+        out.append("roofline (evalkit/roofline.py):")
+        out.extend(_roofline_lines(args, kind, cfg.fft_backend))
+
+        print("\n".join(out))
+
+    if args.obs:
+        import json
+        print("obs metrics: "
+              + json.dumps(obs.metrics.snapshot(), sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Process-global named counters and gauges.
+
+The runtime's measured decisions (wisdom hits vs. races, wire-budget
+rejections, HLO collective census) previously left no machine-readable
+residue; this registry is their single accounting surface. It is ALWAYS
+active — incrementing a counter is a dict update under a lock, touches no
+jax state, and cannot perturb a compiled program — while the event log
+(``tracing.py``) stays opt-in.
+
+Consumers: ``bench.py`` folds ``snapshot()`` into ``BENCH_DETAILS.json``
+(per child process, keys ``obs_metrics_mesh`` / ``obs_metrics_tpu``), the
+CLIs print it under ``--obs``, and ``dfft-explain`` reports the census
+gauges its compile populates.
+
+Metric names (the stable vocabulary; see README "Observability"):
+
+========================== ======= ==========================================
+name                       kind    meaning
+========================== ======= ==========================================
+wisdom.hits                counter resolutions served from the wisdom store
+wisdom.misses              counter resolutions that had to race (or default)
+wisdom.migrations          counter legacy stores migrated on load (per path)
+autotune.race_cells        counter candidate cells measured by any racer
+wire.budget_rejections     counter bf16 twins rejected by the error budget
+wire.exchanges_traced      counter exchanges built into traced programs
+wire.bytes_per_transpose   gauge   wire bytes of the last traced exchange's
+                                   per-shard payload (``wire_nbytes``)
+hlo.all_to_all             gauge   last ``async_collective_counts`` census
+hlo.all_to_all_start       gauge   (instance counts in the compiled module;
+hlo.collective_permute     gauge   ``hlo.async_total`` is the async-start
+hlo.collective_permute_start gauge sum — the overlap detector)
+hlo.async_total            gauge
+hlo.convert                gauge
+========================== ======= ==========================================
+
+Counters accumulate until ``reset()`` (tests reset between plans); gauges
+hold the last value set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, Number] = {}
+_GAUGES: Dict[str, Number] = {}
+
+
+def inc(name: str, n: Number = 1) -> None:
+    """Add ``n`` to counter ``name`` (creating it at 0)."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def gauge(name: str, value: Number) -> None:
+    """Set gauge ``name`` to ``value`` (last write wins)."""
+    with _LOCK:
+        _GAUGES[name] = value
+
+
+def counter_value(name: str) -> Number:
+    with _LOCK:
+        return _COUNTERS.get(name, 0)
+
+
+def gauge_value(name: str, default: Number = 0) -> Number:
+    with _LOCK:
+        return _GAUGES.get(name, default)
+
+
+def snapshot() -> Dict[str, Dict[str, Number]]:
+    """Point-in-time copy: ``{"counters": {...}, "gauges": {...}}`` with
+    deterministically ordered keys (stable for JSON diffs)."""
+    with _LOCK:
+        return {"counters": {k: _COUNTERS[k] for k in sorted(_COUNTERS)},
+                "gauges": {k: _GAUGES[k] for k in sorted(_GAUGES)}}
+
+
+def reset() -> None:
+    """Clear every counter and gauge (test isolation between plans)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()

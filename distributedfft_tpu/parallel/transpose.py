@@ -27,6 +27,8 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs
+
 # Wire dtypes of the global exchanges (the "wire layer"): how a complex
 # shard is encoded immediately before a collective and decoded immediately
 # after. NATIVE is the bit-identical pass-through (today's path); BF16
@@ -66,7 +68,8 @@ def wire_encode(x, wire: str = WIRE_BF16):
     pass through unchanged."""
     if not _wire_active(x, wire):
         return x
-    return jnp.stack([jnp.real(x), jnp.imag(x)]).astype(jnp.bfloat16)
+    with obs.span("exchange.encode", wire=wire):
+        return jnp.stack([jnp.real(x), jnp.imag(x)]).astype(jnp.bfloat16)
 
 
 def wire_decode(y, dtype, wire: str = WIRE_BF16):
@@ -76,9 +79,11 @@ def wire_decode(y, dtype, wire: str = WIRE_BF16):
     validate_wire(wire)
     if wire == WIRE_NATIVE:
         return y
-    f = (jnp.float64 if np.dtype(dtype) == np.complex128 else jnp.float32)
-    z = y.astype(f)
-    return lax.complex(z[0], z[1])
+    with obs.span("exchange.decode", wire=wire):
+        f = (jnp.float64 if np.dtype(dtype) == np.complex128
+             else jnp.float32)
+        z = y.astype(f)
+        return lax.complex(z[0], z[1])
 
 
 def wire_complex_dtype(double_prec: bool):
@@ -228,6 +233,11 @@ def chunked_reshard(x, target, axis: int, k: int):
     the pieces split the LOCAL sub-axis, so each piece takes the same
     local rows of every shard and the K piece exchanges together move
     exactly the monolithic exchange's bytes."""
+    with obs.span("exchange.chunked_reshard", axis=axis, k=k):
+        return _chunked_reshard_impl(x, target, axis, k)
+
+
+def _chunked_reshard_impl(x, target, axis: int, k: int):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -300,6 +310,18 @@ def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
     The ``split_axis`` extent must be divisible by the mesh axis size
     (plans pad). Must be called inside ``shard_map`` over ``axis_name``.
     """
+    obs.metrics.inc("wire.exchanges_traced")
+    obs.metrics.gauge("wire.bytes_per_transpose",
+                      wire_nbytes(x.shape, x.dtype, wire))
+    with obs.span("exchange.ring", axis=axis_name, wire=wire):
+        return _ring_transpose_impl(x, axis_name, split_axis, concat_axis,
+                                    pipeline_fn=pipeline_fn, wire=wire)
+
+
+def _ring_transpose_impl(x, axis_name: str, split_axis: int,
+                         concat_axis: int, *, pipeline_fn, wire: str):
+    """``ring_transpose`` proper (split out so the obs span wraps one
+    call site)."""
     p = _axis_size(axis_name)
     wired = _wire_active(x, wire)
     if pipeline_fn is None:
@@ -401,13 +423,20 @@ def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
     moves the pipeline transpose pair from 0.59x to ~1.0x of the pure
     exchange ceiling (the north-star gate).
     """
-    if _wire_active(x, wire):
-        y = wire_encode(x, wire)
-        y = _all_to_all_native(y, axis_name, split_axis + 1, concat_axis + 1,
-                               realigned)
-        return wire_decode(y, x.dtype, wire)
-    return _all_to_all_native(x, axis_name, split_axis, concat_axis,
-                              realigned)
+    # Per-traced-exchange accounting (obs registry): shard-local payload
+    # wire bytes, recorded once per trace, not per execution.
+    obs.metrics.inc("wire.exchanges_traced")
+    obs.metrics.gauge("wire.bytes_per_transpose",
+                      wire_nbytes(x.shape, x.dtype, wire))
+    with obs.span("exchange.all_to_all", axis=axis_name,
+                  realigned=bool(realigned), wire=wire):
+        if _wire_active(x, wire):
+            y = wire_encode(x, wire)
+            y = _all_to_all_native(y, axis_name, split_axis + 1,
+                                   concat_axis + 1, realigned)
+            return wire_decode(y, x.dtype, wire)
+        return _all_to_all_native(x, axis_name, split_axis, concat_axis,
+                                  realigned)
 
 
 def _all_to_all_native(x, axis_name: str, split_axis: int, concat_axis: int,

@@ -30,6 +30,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..params import FFTNorm
 from . import chaintimer
 
@@ -149,10 +150,13 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
                             precision=mxu_fft.as_precision(c.precision))
             if c.direct_max is not None:
                 st = dc.replace(st, direct_max=c.direct_max)
+        obs.metrics.inc("autotune.race_cells")
         try:
-            c.per_iter_ms, c.rel_err, c.error = _measure(
-                shape, c.backend, k, repeats, inner, x, x_absmax,
-                settings=st)
+            with obs.span("autotune.race_cell", race="local_fft",
+                          label=c.label):
+                c.per_iter_ms, c.rel_err, c.error = _measure(
+                    shape, c.backend, k, repeats, inner, x, x_absmax,
+                    settings=st)
             c.ok = (c.error is None and c.rel_err <= budget_rel_err)
         except Exception as e:  # backend unavailable on this platform
             c.error = f"{type(e).__name__}: {e}"
@@ -255,43 +259,51 @@ def _measure_comm_candidates(cands, kind, global_size, partition, base,
         tuple(global_size.shape)).astype(rdt)
     ref_spec = None
     for c in cands:
+        obs.metrics.inc("autotune.race_cells")
         try:
-            cfg = dc.replace(base, comm_method=c.comm, comm_method2=c.comm2,
-                             opt=c.opt)
-            if c.send is not None:
-                cfg = dc.replace(cfg, send_method=c.send, send_method2=None,
-                                 streams_chunks=c.chunks)
-            if c.wire is not None:
-                cfg = dc.replace(cfg, wire_dtype=c.wire)
-            plan = tc.make_plan(kind, global_size, partition, cfg,
-                                sequence=sequence, mesh=mesh,
-                                transform=transform)
-            x = plan.pad_input(xs)
-            fwd, inv = tc._fused_fns(plan, dims)
-            c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
-            spec = fwd(x)
-            c.inv_ms = _time_plan_ms(inv, spec, iterations, warmup)
-            compressed = c.wire not in (None, "native")
-            if not compressed and ref_spec is None:
-                ref_spec = spec
-            if compressed:
-                # The gate runs BEFORE ok is set: a lossy candidate whose
-                # accuracy could not be established (no native reference,
-                # or the error computation itself failed) must never rank
-                # as usable.
-                if ref_spec is None:
-                    raise RuntimeError(
-                        "no native reference measured before the "
-                        "compressed candidate (racer list-order contract)")
-                from .microbench import max_rel_err
-                c.wire_rel_err = max_rel_err(spec, ref_spec)
-                if not c.wire_rel_err <= budget:
-                    c.error = (f"wire rel err {c.wire_rel_err:.2e} over "
-                               f"budget {budget:.0e}")
+            with obs.span("autotune.race_cell", race="comm", label=c.label):
+                cfg = dc.replace(base, comm_method=c.comm,
+                                 comm_method2=c.comm2, opt=c.opt)
+                if c.send is not None:
+                    cfg = dc.replace(cfg, send_method=c.send,
+                                     send_method2=None,
+                                     streams_chunks=c.chunks)
+                if c.wire is not None:
+                    cfg = dc.replace(cfg, wire_dtype=c.wire)
+                plan = tc.make_plan(kind, global_size, partition, cfg,
+                                    sequence=sequence, mesh=mesh,
+                                    transform=transform)
+                x = plan.pad_input(xs)
+                fwd, inv = tc._fused_fns(plan, dims)
+                c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
+                spec = fwd(x)
+                c.inv_ms = _time_plan_ms(inv, spec, iterations, warmup)
+                compressed = c.wire not in (None, "native")
+                if not compressed and ref_spec is None:
+                    ref_spec = spec
+                if compressed:
+                    # The gate runs BEFORE ok is set: a lossy candidate
+                    # whose accuracy could not be established (no native
+                    # reference, or the error computation itself failed)
+                    # must never rank as usable.
+                    if ref_spec is None:
+                        raise RuntimeError(
+                            "no native reference measured before the "
+                            "compressed candidate (racer list-order "
+                            "contract)")
+                    from .microbench import max_rel_err
+                    c.wire_rel_err = max_rel_err(spec, ref_spec)
+                    if not c.wire_rel_err <= budget:
+                        c.error = (f"wire rel err {c.wire_rel_err:.2e} over "
+                                   f"budget {budget:.0e}")
+                        obs.metrics.inc("wire.budget_rejections")
+                        obs.event("wire.budget_rejected", label=c.label,
+                                  rel_err=float(c.wire_rel_err),
+                                  budget=float(budget))
+                    else:
+                        c.ok = True
                 else:
                     c.ok = True
-            else:
-                c.ok = True
         except Exception as e:  # strategy unavailable for this shape/mesh
             c.ok = False
             c.error = f"{type(e).__name__}: {e}"
@@ -429,10 +441,13 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
             c.wire = "native"
         cands = cands + [dc.replace(c, wire="bf16") for c in cands]
 
-    _measure_comm_candidates(cands, kind, global_size, partition, base,
-                             mesh, sequence, dims, transform, iterations,
-                             warmup, seed, budget, verbose)
-    return _rank_and_agree(cands)
+    with obs.span("autotune.race_comm", kind=kind,
+                  shape=list(global_size.shape), cells=len(cands),
+                  race_wire=bool(race_wire)):
+        _measure_comm_candidates(cands, kind, global_size, partition, base,
+                                 mesh, sequence, dims, transform, iterations,
+                                 warmup, seed, budget, verbose)
+        return _rank_and_agree(cands)
 
 
 def autotune_wire(kind: str, global_size, partition, base_config=None,
@@ -469,10 +484,12 @@ def autotune_wire(kind: str, global_size, partition, base_config=None,
     # would time/gate a rendering the caller never runs.
     cands = [CommCandidate(base.comm_method, comm2, base.opt, wire=w)
              for w in ("native", "bf16")]
-    _measure_comm_candidates(cands, kind, global_size, partition, base,
-                             mesh, sequence, dims, transform, iterations,
-                             warmup, seed, budget, verbose)
-    return _rank_and_agree(cands)
+    with obs.span("autotune.race_wire", kind=kind,
+                  shape=list(global_size.shape)):
+        _measure_comm_candidates(cands, kind, global_size, partition, base,
+                                 mesh, sequence, dims, transform, iterations,
+                                 warmup, seed, budget, verbose)
+        return _rank_and_agree(cands)
 
 
 def apply_best_comm(candidates: List[CommCandidate], base_config=None):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import obs
 from ..parallel.mesh import make_pencil_mesh, make_slab_mesh
 from ..parallel.transpose import all_to_all_transpose, realigned_pack_shape
 
@@ -77,6 +78,11 @@ def async_collective_counts(hlo) -> Dict[str, int]:
     out["async_total"] = (out["all_to_all_start"]
                           + out["collective_permute_start"])
     out["convert"] = txt.count(" convert(")
+    # Mirror the census into the obs registry (``hlo.*`` gauges — last
+    # census wins), so a bench/explain run's collective counts land in the
+    # metrics snapshot without every caller re-plumbing them.
+    for name, v in out.items():
+        obs.metrics.gauge(f"hlo.{name}", v)
     return out
 
 

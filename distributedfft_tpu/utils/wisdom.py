@@ -66,7 +66,40 @@ import contextlib
 import json
 import os
 import tempfile
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
+
+try:
+    from .. import obs
+except ImportError:
+    # Standalone load (tests exercise the advisory-lock contract by
+    # exec'ing this file without the package): observability degrades to
+    # no-ops, exactly like every other wisdom failure mode.
+    import contextlib as _contextlib
+
+    class _NullObs:  # noqa: D401 — minimal stand-in
+        class metrics:
+            @staticmethod
+            def inc(name, n=1):
+                pass
+
+            @staticmethod
+            def gauge(name, value):
+                pass
+
+        @staticmethod
+        def span(name, **attrs):
+            return _contextlib.nullcontext()
+
+        @staticmethod
+        def event(name, **attrs):
+            pass
+
+        @staticmethod
+        def notice(msg, **attrs):
+            pass
+
+    obs = _NullObs()
 
 WISDOM_VERSION = 3
 # Store versions that migrate on load instead of reading empty (their
@@ -86,6 +119,24 @@ _RACE_INNER = 2
 _COMM_ITERATIONS = 3
 _COMM_WARMUP = 1
 _FALLBACK_BACKEND = "xla"  # when every candidate fails the gate
+
+# (path, legacy version) pairs already reported: load() runs on every
+# lookup/record, and one store must announce its migration once, not per
+# consult.
+_MIGRATION_SEEN = set()
+
+
+def _note_migration(path: str, version: int) -> None:
+    key = (path, int(version))
+    if key in _MIGRATION_SEEN:
+        return
+    _MIGRATION_SEEN.add(key)
+    obs.metrics.inc("wisdom.migrations")
+    obs.notice(
+        f"wisdom: migrated(v{version}→v{WISDOM_VERSION}) {path} "
+        f"(local_fft carries over; comm records re-race as misses)",
+        name="wisdom.migration", path=path, from_version=int(version),
+        to_version=WISDOM_VERSION)
 
 
 def _race_k() -> int:
@@ -180,19 +231,33 @@ class WisdomStore:
         schema, unknown version) degrades to the empty store. A version-1
         or -2 store migrates (see ``_migrate_legacy``) instead of reading
         empty."""
+        with obs.span("wisdom.load", path=self.path):
+            try:
+                with open(self.path, "r", encoding="utf-8") as f:
+                    raw = json.load(f)
+            except (OSError, ValueError):
+                return self._empty()
+            if (not isinstance(raw, dict)
+                    or not isinstance(raw.get("entries"), dict)):
+                return self._empty()
+            if raw.get("version") in _LEGACY_VERSIONS:
+                _note_migration(self.path, raw["version"])
+                return self._migrate_legacy(raw)
+            if raw.get("version") != WISDOM_VERSION:
+                return self._empty()
+            return raw
+
+    def raw_version(self) -> Optional[int]:
+        """The on-disk schema version (before migration), or None when the
+        file is missing/unreadable — what ``dfft-explain`` reports as the
+        store's provenance."""
         try:
             with open(self.path, "r", encoding="utf-8") as f:
                 raw = json.load(f)
         except (OSError, ValueError):
-            return self._empty()
-        if (not isinstance(raw, dict)
-                or not isinstance(raw.get("entries"), dict)):
-            return self._empty()
-        if raw.get("version") in _LEGACY_VERSIONS:
-            return self._migrate_legacy(raw)
-        if raw.get("version") != WISDOM_VERSION:
-            return self._empty()
-        return raw
+            return None
+        v = raw.get("version") if isinstance(raw, dict) else None
+        return v if isinstance(v, int) else None
 
     def lookup(self, key: str, slot: str) -> Optional[Dict[str, Any]]:
         """The recorded dict under ``entries[key][slot]``, or None."""
@@ -207,11 +272,17 @@ class WisdomStore:
         advisory lock across the read-merge-replace window so concurrent
         recorders serialize instead of losing each other's updates.
         Best-effort: returns False (never raises) when the write cannot
-        land."""
+        land. Records are stamped with ``recorded_at`` (UTC ISO-8601) so
+        provenance surfaces (``dfft-explain``) can say WHEN a winner was
+        measured; readers tolerate the extra key."""
+        rec = dict(rec)
+        rec.setdefault("recorded_at",
+                       time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
         try:
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
-            with _advisory_lock(self.path):
+            with obs.span("wisdom.record", path=self.path, slot=slot), \
+                    _advisory_lock(self.path):
                 data = self.load()  # re-read: merge with concurrent writers
                 entry = data["entries"].setdefault(key, {})
                 if not isinstance(entry, dict):  # damaged entry: replace
@@ -474,6 +545,100 @@ def _wire_hit_within_budget(rec, budget: float) -> bool:
     return budget <= raced
 
 
+def _no_collectives(kind: str, partition, variant, dims: int) -> bool:
+    """Whether a plan configuration issues no exchange at all (single
+    rank, the embarrassingly-parallel batched2d batch sharding, or a
+    dims<2 partial transform): its comm/wire 'auto' markers resolve to
+    defaults without any store consult or race. ONE predicate shared by
+    ``_resolve_comm``/``_resolve_wire`` and the lookup-only
+    ``peek_config`` so dfft-explain can never disagree with plan
+    construction about whether a slot was consulted."""
+    single = partition.num_ranks == 1 or (kind == "batched2d"
+                                          and variant == "batch")
+    return single or dims < 2
+
+
+def _comm_hit_fold(norm_base, rec, race_wire: bool, budget: float):
+    """``(folded Config or None, miss-reason or None)`` for a stored
+    ``comm`` record — the single hit/miss decision shared by
+    ``_resolve_comm`` and the lookup-only ``peek_config`` (dfft-explain),
+    so the explain surface can never disagree with what plan construction
+    would do."""
+    if rec is None:
+        return None, "no record"
+    try:
+        folded = _fold_comm_rec(norm_base, rec)
+    except (KeyError, TypeError, ValueError):
+        return None, "stale record"  # re-measure
+    if race_wire and not rec.get("wire_raced"):
+        # The record predates a wire race the caller delegated (its
+        # native wire never competed against the compressed twin): an
+        # ordinary miss, re-raced with the wire axis.
+        return None, "record predates the wire race"
+    if race_wire and not _wire_hit_within_budget(rec, budget):
+        # Recorded bf16 winner, but its measured error exceeds THIS
+        # caller's (tighter) budget: re-race under it.
+        return None, "recorded wire winner fails this error budget"
+    if not race_wire and folded.wire_dtype != norm_base.wire_dtype:
+        # The record's comm/send/opt winner was raced under a DIFFERENT
+        # wire encoding than the caller's explicit one; its ranking may
+        # not transfer (compression changes the exchange bytes the race
+        # compared), and a fold must reproduce a program the race
+        # actually timed. Re-race at the caller's wire — the new record
+        # then carries it.
+        return None, "record raced under a different wire encoding"
+    return folded, None
+
+
+def _wire_hit_fold(base, rec, budget: float):
+    """``(folded Config or None, miss-reason or None)`` for a stored
+    ``wire``-slot record (shared by ``_resolve_wire`` and
+    ``peek_config``)."""
+    if rec is None:
+        return None, "no record"
+    try:
+        folded = _fold_wire_rec(base, rec)
+    except (KeyError, TypeError, ValueError):
+        return None, "stale record"
+    if not _wire_hit_within_budget(rec, budget):
+        # Budget is not part of the plan key: check at fold time.
+        return None, "recorded wire winner fails this error budget"
+    return folded, None
+
+
+def _describe_comm(cfg) -> str:
+    """Compact human label of a resolved comm/send/opt/wire choice (the
+    provenance notices and dfft-explain share it)."""
+    from .. import params as pm
+    tag = cfg.comm_method.value
+    if cfg.comm_method2 is not None:
+        tag += f"+{cfg.comm_method2.value}"
+    tag += f"/opt{cfg.opt}"
+    if cfg.send_method is pm.SendMethod.RING:
+        tag += "/ring"
+    elif cfg.send_method is pm.SendMethod.STREAMS:
+        tag += f"/streams{cfg.resolved_streams_chunks()}"
+    if cfg.wire_dtype != "native":
+        tag += f"/{cfg.wire_dtype}"
+    return tag
+
+
+def _hit_notice(slot: str, detail: str, store) -> None:
+    obs.metrics.inc("wisdom.hits")
+    src = store.path if store is not None else "no store"
+    obs.notice(f"wisdom[{slot}]: hit ({detail}) <- {src}",
+               name="wisdom.provenance", slot=slot, status="hit",
+               detail=detail, store=getattr(store, "path", None))
+
+
+def _miss_notice(slot: str, reason: str, store, action: str) -> None:
+    obs.metrics.inc("wisdom.misses")
+    src = store.path if store is not None else "no store configured"
+    obs.notice(f"wisdom[{slot}]: miss ({reason}; {src}) -> {action}",
+               name="wisdom.provenance", slot=slot, status="miss",
+               reason=reason, store=getattr(store, "path", None))
+
+
 def resolve_local_backend(shape: Sequence[int], double_prec: bool = False,
                           path: Optional[str] = None, enabled: bool = True,
                           race_on_miss: bool = True,
@@ -488,9 +653,13 @@ def resolve_local_backend(shape: Sequence[int], double_prec: bool = False,
     key = local_key(shape, double_prec)
     rec = store.lookup(key, "local_fft") if store else None
     if rec is not None and _valid_local_rec(rec):
+        _hit_notice("local_fft", rec["fft_backend"], store)
         return rec["fft_backend"], rec
     if not race_on_miss:
         return default, None
+    _miss_notice("local_fft",
+                 "no record" if rec is None else "stale record", store,
+                 "racing local-FFT backends")
     from ..testing import autotune as at
     try:
         ranked = at.autotune_local_fft(shape, k=_race_k(),
@@ -547,7 +716,11 @@ def _resolve_local_fft(cfg, store, key, kind, global_size, partition,
 
     rec = store.lookup(key, "local_fft") if store else None
     if rec is not None and _valid_local_rec(rec):
+        _hit_notice("local_fft", rec["fft_backend"], store)
         return _fold_local_rec(cfg, rec)
+    _miss_notice("local_fft",
+                 "no record" if rec is None else "stale record", store,
+                 "racing local-FFT backends")
     from ..testing import autotune as at
     shape = _race_shape(kind, global_size, partition, variant)
     best = None
@@ -624,7 +797,8 @@ def _broadcast_comm_hit(folded, base):
              else int(folded.streams_chunks)),
             _WIRE_CONCRETE.index(folded.wire_dtype),
         ], dtype=np.int64)
-    vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
+    with obs.span("wisdom.broadcast", what="comm_hit"):
+        vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
     if int(vec[0]) != 1:
         return None
     import dataclasses as dc
@@ -646,9 +820,7 @@ def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
 
     from .. import params as pm
 
-    single = partition.num_ranks == 1 or (kind == "batched2d"
-                                          and variant == "batch")
-    if single or dims < 2:
+    if _no_collectives(kind, partition, variant, dims):
         return _comm_defaults(cfg)
     # "auto" owns the whole comm x send x opt x chunks choice (params.py
     # contract): hits fold and winners apply onto a SYNC-normalized base,
@@ -660,36 +832,20 @@ def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
     norm_base = dc.replace(_comm_defaults(cfg),
                            send_method=pm.SendMethod.SYNC,
                            send_method2=None, streams_chunks=None)
-    folded = None
     rec = store.lookup(key, "comm") if store else None
-    if rec is not None:
-        try:
-            folded = _fold_comm_rec(norm_base, rec)
-            if race_wire and not rec.get("wire_raced"):
-                # The record predates a wire race the caller delegated
-                # (its native wire never competed against the compressed
-                # twin): an ordinary miss, re-raced with the wire axis.
-                folded = None
-            elif race_wire and not _wire_hit_within_budget(
-                    rec, cfg.resolved_wire_budget()):
-                # Recorded bf16 winner, but its measured error exceeds
-                # THIS caller's (tighter) budget: re-race under it.
-                folded = None
-            elif not race_wire \
-                    and folded.wire_dtype != norm_base.wire_dtype:
-                # The record's comm/send/opt winner was raced under a
-                # DIFFERENT wire encoding than the caller's explicit one;
-                # its ranking may not transfer (compression changes the
-                # exchange bytes the race compared), and a fold must
-                # reproduce a program the race actually timed. Re-race at
-                # the caller's wire — the new record then carries it.
-                folded = None
-        except (KeyError, TypeError, ValueError):
-            folded = None  # stale record: re-measure
+    folded, reason = _comm_hit_fold(norm_base, rec, race_wire,
+                                    cfg.resolved_wire_budget())
     if jax.process_count() > 1:
+        had_local = folded is not None
         folded = _broadcast_comm_hit(folded, norm_base)
+        if folded is None and had_local:
+            reason = "process 0 missed"
     if folded is not None:
+        _hit_notice("comm", _describe_comm(folded), store)
         return folded
+    _miss_notice("comm", reason or "no record", store,
+                 "racing the comm matrix"
+                 + (" (wire axis included)" if race_wire else ""))
     from ..testing import autotune as at
     base = dc.replace(norm_base, comm_method=pm.CommMethod.ALL2ALL,
                       comm_method2=None)
@@ -716,7 +872,8 @@ def _broadcast_wire_hit(folded, base):
     from jax.experimental import multihost_utils
     code = (-1 if folded is None
             else _WIRE_CONCRETE.index(folded.wire_dtype))
-    code = int(multihost_utils.broadcast_one_to_all(np.int64(code)))
+    with obs.span("wisdom.broadcast", what="wire_hit"):
+        code = int(multihost_utils.broadcast_one_to_all(np.int64(code)))
     if code < 0:
         return None
     import dataclasses as dc
@@ -736,27 +893,21 @@ def _resolve_wire(cfg, store, key, kind, global_size, partition, mesh,
 
     from .. import params as pm
 
-    single = partition.num_ranks == 1 or (kind == "batched2d"
-                                          and variant == "batch")
-    if single or dims < 2:
+    if _no_collectives(kind, partition, variant, dims):
         return dc.replace(cfg, wire_dtype="native")
     base = dc.replace(cfg, wire_dtype="native")
-    folded = None
     rec = store.lookup(key, "wire") if store else None
-    if rec is not None:
-        try:
-            folded = _fold_wire_rec(base, rec)
-            if not _wire_hit_within_budget(rec,
-                                           cfg.resolved_wire_budget()):
-                # Recorded bf16 winner over THIS caller's (tighter)
-                # budget: re-race under it (budget is not in the key).
-                folded = None
-        except (KeyError, TypeError, ValueError):
-            folded = None  # stale record: re-measure
+    folded, reason = _wire_hit_fold(base, rec, cfg.resolved_wire_budget())
     if jax.process_count() > 1:
+        had_local = folded is not None
         folded = _broadcast_wire_hit(folded, base)
+        if folded is None and had_local:
+            reason = "process 0 missed"
     if folded is not None:
+        _hit_notice("wire", folded.wire_dtype, store)
         return folded
+    _miss_notice("wire", reason or "no record", store,
+                 "racing native vs bf16 on the fixed rendering")
     from ..testing import autotune as at
     try:
         ranked = at.autotune_wire(kind, global_size, partition, base,
@@ -810,7 +961,8 @@ def _agree_across_processes(cfg):
         -1 if cfg.streams_chunks is None else int(cfg.streams_chunks),
         _WIRE_CONCRETE.index(cfg.wire_dtype),
     ], dtype=np.int64)
-    vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
+    with obs.span("wisdom.broadcast", what="resolved_config"):
+        vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
     return dc.replace(
         cfg,
         fft_backend=BACKENDS[int(vec[0])],
@@ -843,19 +995,108 @@ def resolve_config(kind: str, global_size, partition, config=None, *,
     wants_wire = cfg.wire_dtype == pm.AUTO
     if not (wants_fft or wants_comm or wants_wire):
         return cfg
+    with obs.span("plan.resolve", kind=kind,
+                  shape=list(global_size.shape), transform=transform,
+                  dims=dims):
+        store = store_for_config(cfg)
+        key = plan_key(kind, global_size.shape, cfg.double_prec, partition,
+                       cfg.norm, transform=transform, sequence=sequence,
+                       variant=variant,
+                       mesh_shape=_mesh_shape_of(mesh, partition), dims=dims)
+        if wants_fft:
+            cfg = _resolve_local_fft(cfg, store, key, kind, global_size,
+                                     partition, variant)
+        if wants_comm:
+            # Owns the wire axis too when it is "auto" (race_wire).
+            cfg = _resolve_comm(cfg, store, key, kind, global_size,
+                                partition, mesh, sequence, transform, dims,
+                                variant)
+        elif wants_wire:
+            cfg = _resolve_wire(cfg, store, key, kind, global_size,
+                                partition, mesh, sequence, transform, dims,
+                                variant)
+        return _agree_across_processes(cfg)
+
+
+def peek_config(kind: str, global_size, partition, config=None, *,
+                mesh=None, sequence=None, transform: str = "r2c",
+                dims: int = 3, variant: Optional[str] = None):
+    """LOOKUP-ONLY resolution + provenance: ``(cfg, provenance)``.
+
+    The ``dfft-explain`` surface — it must report the fully resolved plan
+    WITHOUT executing anything, so unlike ``resolve_config`` a miss never
+    races: it folds the same defaults a raceless resolution would
+    (``fft_backend`` -> the xla fallback, comm/wire -> ``_comm_defaults``)
+    and reports the slot as a miss. Hit/miss decisions go through the
+    exact helpers ``_resolve_comm``/``_resolve_wire`` use
+    (``_comm_hit_fold``/``_wire_hit_fold``), so explain can never disagree
+    with what plan construction would do on the same store.
+
+    ``provenance`` = ``{"store_path", "store_version" (on-disk, pre-
+    migration, None when absent), "key", "slots": {slot: {"status":
+    "hit"|"miss"|"not consulted (...)", "reason", "record"}}}``. Slots
+    appear only for Config fields that were actually ``"auto"``."""
+    import dataclasses as dc
+
+    from .. import params as pm
+    cfg = config if config is not None else pm.Config()
     store = store_for_config(cfg)
     key = plan_key(kind, global_size.shape, cfg.double_prec, partition,
                    cfg.norm, transform=transform, sequence=sequence,
                    variant=variant,
                    mesh_shape=_mesh_shape_of(mesh, partition), dims=dims)
+    prov = {"store_path": store.path if store else None,
+            "store_version": store.raw_version() if store else None,
+            "key": key, "slots": {}}
+    wants_fft = cfg.fft_backend == pm.AUTO
+    wants_comm = pm.AUTO in (cfg.comm_method, cfg.comm_method2)
+    wants_wire = cfg.wire_dtype == pm.AUTO
+    no_coll = _no_collectives(kind, partition, variant, dims)
     if wants_fft:
-        cfg = _resolve_local_fft(cfg, store, key, kind, global_size,
-                                 partition, variant)
+        rec = store.lookup(key, "local_fft") if store else None
+        if rec is not None and _valid_local_rec(rec):
+            cfg = _fold_local_rec(cfg, rec)
+            prov["slots"]["local_fft"] = {"status": "hit", "record": rec}
+        else:
+            cfg = dc.replace(cfg, fft_backend=_FALLBACK_BACKEND)
+            prov["slots"]["local_fft"] = {
+                "status": "miss",
+                "reason": "no record" if rec is None else "stale record"}
     if wants_comm:
-        # Owns the wire axis too when it is "auto" (race_wire).
-        cfg = _resolve_comm(cfg, store, key, kind, global_size, partition,
-                            mesh, sequence, transform, dims, variant)
+        if no_coll:
+            cfg = _comm_defaults(cfg)
+            prov["slots"]["comm"] = {
+                "status": "not consulted (plan issues no collectives)"}
+        else:
+            race_wire = cfg.wire_dtype == pm.AUTO
+            norm_base = dc.replace(_comm_defaults(cfg),
+                                   send_method=pm.SendMethod.SYNC,
+                                   send_method2=None, streams_chunks=None)
+            rec = store.lookup(key, "comm") if store else None
+            folded, reason = _comm_hit_fold(norm_base, rec, race_wire,
+                                            cfg.resolved_wire_budget())
+            if folded is not None:
+                cfg = folded
+                prov["slots"]["comm"] = {"status": "hit", "record": rec}
+            else:
+                cfg = norm_base
+                prov["slots"]["comm"] = {"status": "miss", "reason": reason,
+                                         "record": rec}
     elif wants_wire:
-        cfg = _resolve_wire(cfg, store, key, kind, global_size, partition,
-                            mesh, sequence, transform, dims, variant)
-    return _agree_across_processes(cfg)
+        if no_coll:
+            cfg = dc.replace(cfg, wire_dtype="native")
+            prov["slots"]["wire"] = {
+                "status": "not consulted (plan issues no collectives)"}
+        else:
+            base = dc.replace(cfg, wire_dtype="native")
+            rec = store.lookup(key, "wire") if store else None
+            folded, reason = _wire_hit_fold(base, rec,
+                                            cfg.resolved_wire_budget())
+            if folded is not None:
+                cfg = folded
+                prov["slots"]["wire"] = {"status": "hit", "record": rec}
+            else:
+                cfg = base
+                prov["slots"]["wire"] = {"status": "miss", "reason": reason,
+                                         "record": rec}
+    return cfg, prov

@@ -569,6 +569,21 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
         stages = plan.forward_stages()
         x = plan.pad_input(np.random.default_rng(0).random(g.shape)
                            .astype(np.float32))
+
+        # --selftest forwarding (parent flag -> DFFT_BENCH_SELFTEST): one
+        # guarded roundtrip of the mesh plan before anything is timed —
+        # the PASS/FAIL line and residuals land in the child JSON.
+        if os.environ.get("DFFT_BENCH_SELFTEST"):
+            try:
+                from distributedfft_tpu.resilience.selftest import \
+                    run_selftest
+                st = run_selftest(plan)
+                out["selftest"] = {"ok": st["ok"], "checks": st["checks"]}
+            except TimeoutError:
+                raise
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                out["selftest"] = {"ok": False,
+                                   "error": f"{type(e).__name__}: {e}"[:200]}
         vals = [x]
         xpose_fn = None
         xdesc = plan._xpose_desc()
@@ -1248,6 +1263,13 @@ if __name__ == "__main__":
             sys.exit(2)
         os.environ["DFFT_BENCH_PROFILE_DIR"] = sys.argv[_i + 1]
         del sys.argv[_i:_i + 2]
+    # --selftest (parent only): forwarded via DFFT_BENCH_SELFTEST — the
+    # mesh child runs one guarded roundtrip (resilience/selftest.py) of
+    # its slab plan before the timed gates and folds the PASS/FAIL +
+    # residuals into its JSON (same hand-parsing rationale as above).
+    if "--selftest" in sys.argv:
+        os.environ["DFFT_BENCH_SELFTEST"] = "1"
+        sys.argv.remove("--selftest")
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         name = sys.argv[2]
         if name == "probe":

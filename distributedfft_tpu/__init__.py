@@ -52,6 +52,7 @@ from .models.base import DistFFTPlan
 from .models.batched2d import Batched2DFFTPlan
 from .models.pencil import PencilFFTPlan
 from .models.slab import SlabFFTPlan
+from .resilience import GuardViolation
 from .solvers.poisson import PoissonSolver
 
 __all__ = [
@@ -59,8 +60,8 @@ __all__ = [
     "PencilPartition", "SendMethod", "SlabPartition", "SlabSequence",
     "block_sizes", "block_starts", "padded_extent", "parse_comm_method",
     "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
-    "make_slab_mesh", "Batched2DFFTPlan", "DistFFTPlan", "PencilFFTPlan",
-    "PoissonSolver", "SlabFFTPlan",
+    "make_slab_mesh", "Batched2DFFTPlan", "DistFFTPlan", "GuardViolation",
+    "PencilFFTPlan", "PoissonSolver", "SlabFFTPlan",
     "global_from_local", "maybe_initialize", "process_local_slices",
 ]
 

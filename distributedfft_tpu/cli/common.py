@@ -151,6 +151,22 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                          "the actual shape) the 'auto' wire race accepts "
                          "from a compressed wire (default 2e-2); tighter "
                          "budgets fall back to native")
+    ap.add_argument("--guards", default=None,
+                    choices=("off", "check", "enforce"),
+                    help="in-graph numerical guards (resilience layer; "
+                         "default $DFFT_GUARDS or 'off'): 'check' adds a "
+                         "Parseval/energy-conservation residual and (on a "
+                         "compressed wire) a drift probe to every jitted "
+                         "pipeline — violations are counted/noticed and a "
+                         "drifting wire demotes itself to native; "
+                         "'enforce' raises a structured GuardViolation "
+                         "instead (README 'Resilience')")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run one guarded forward+inverse roundtrip of "
+                         "this exact plan (Parseval + roundtrip identity "
+                         "+ host np.fft reference at small sizes) and "
+                         "print a PASS/FAIL line before the timed loop; "
+                         "FAIL aborts with exit code 1")
     ap.add_argument("--tc1-truth", choices=("host", "analytic"),
                     default="host",
                     help="testcase-1 ground truth: 'host' = dense random "
@@ -178,6 +194,23 @@ def wire_config_kwargs(args) -> dict:
     return {"wire_dtype": pm.parse_wire_dtype(
                 getattr(args, "wire_dtype", "native")),
             "wire_error_budget": getattr(args, "wire_error_budget", None)}
+
+
+def resilience_config_kwargs(args) -> dict:
+    """Config kwargs carrying the CLI resilience surface (--guards).
+    Default None defers to $DFFT_GUARDS -> "off", reproducing pre-guard
+    behavior (byte-identical programs) exactly."""
+    return {"guards": getattr(args, "guards", None)}
+
+
+def maybe_selftest(plan, args, dims=None) -> bool:
+    """--selftest: one guarded roundtrip of the exact plan before the
+    timed loop (resilience/selftest.py); returns False — abort with exit
+    code 1 — on FAIL."""
+    if not getattr(args, "selftest", False):
+        return True
+    from ..resilience.selftest import run_selftest
+    return bool(run_selftest(plan, dims=dims)["ok"])
 
 
 def maybe_autotune_comm(args, kind, global_size, partition, cfg,
@@ -273,6 +306,10 @@ def run_testcase(plan, args, dims=None) -> int:
               "testcase 1 with --tc1-truth analytic",
               file=sys.stderr)
         return 2
+    if not maybe_selftest(plan, args, dims=dims):
+        print("selftest FAILED; aborting before the timed loop",
+              file=sys.stderr)
+        return 1
     kwargs = {}
     if args.testcase in (0, 2, 3, 4):
         kwargs.update(iterations=args.iterations, warmup=args.warmup_rounds)

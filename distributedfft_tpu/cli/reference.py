@@ -60,6 +60,22 @@ def main(argv=None) -> int:
     dtype = np.float64 if args.double_prec else np.float32
     it, wu = args.iterations, args.warmup_rounds
 
+    if getattr(args, "selftest", False):
+        # The reference executable has no distributed plan; its selftest
+        # is the single-device roundtrip at this shape (the coordinator-
+        # rank baseline every distributed run is validated against).
+        from .. import params as pm
+        from ..models.slab import SlabFFTPlan
+        from ..resilience.selftest import run_selftest
+        be = args.fft_backend if args.fft_backend != "auto" else "xla"
+        plan = SlabFFTPlan(
+            pm.GlobalSize(*shape), pm.SlabPartition(1),
+            pm.Config(double_prec=args.double_prec, fft_backend=be,
+                      guards=getattr(args, "guards", None)))
+        if not run_selftest(plan)["ok"]:
+            print("selftest FAILED; aborting", file=sys.stderr)
+            return 1
+
     if args.autotune:
         from ..testing import autotune as at
         prec = "f64" if args.double_prec else "f32"
@@ -151,7 +167,8 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
         g = pm.GlobalSize(*shape)
         plan = SlabFFTPlan(g, pm.SlabPartition(p),
                            pm.Config(comm_method=pm.CommMethod.ALL2ALL,
-                                     double_prec=args.double_prec))
+                                     double_prec=args.double_prec,
+                                     guards=getattr(args, "guards", None)))
         x = plan.pad_input(np.random.default_rng(0).random(g.shape)
                            .astype(dtype))
         spec = plan.forward_stages()[0][1](x)

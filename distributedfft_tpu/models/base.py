@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..ops import fft as local_fft
 from ..params import Config, FFTNorm, GlobalSize, Partition
+from ..resilience import fallback, guards
 from ..utils import wisdom
 
 
@@ -86,6 +87,12 @@ class DistFFTPlan:
         # resolves to None — such plans keep deferring to the mutable
         # process defaults at trace time (legacy set_* behavior).
         self._mxu_st = self.config.mxu_settings()
+        # Resilience state: the guard mode is resolved ONCE here (Config
+        # field -> $DFFT_GUARDS -> off), so a mid-run env change cannot
+        # split a plan's directions across modes; _guard_state holds the
+        # per-direction tolerances the builders stash at wrap time.
+        self._guard_mode = guards.resolved_mode(self.config)
+        self._guard_state = {}
         self.mesh = mesh
         # Single-process fallback flag, exactly the reference's
         # ``fft3d = (pcnt == 1)`` (src/mpicufft.cpp:65).
@@ -141,21 +148,40 @@ class DistFFTPlan:
     # -- execution --------------------------------------------------------
 
     def exec_r2c(self, x):
-        """Forward real-to-complex transform (reference ``execR2C``)."""
-        if self._r2c is None:
-            self._r2c = self._build_r2c()
-        return self._r2c(x)
+        """Forward real-to-complex transform (reference ``execR2C``),
+        inside the resilience envelope (``fallback.execute``): guards
+        checked per the plan's mode, pipeline failures walk the
+        degradation ladder."""
+        return fallback.execute(self, "forward", x, self._get_r2c)
 
     def exec_c2r(self, x):
         """Inverse complex-to-real transform (reference ``execC2R``)."""
+        return fallback.execute(self, "inverse", x, self._get_c2r)
+
+    def _get_r2c(self):
+        if self._r2c is None:
+            self._r2c = self._build_r2c()
+        return self._r2c
+
+    def _get_c2r(self):
         if self._c2r is None:
             self._c2r = self._build_c2r()
-        return self._c2r(x)
+        return self._c2r
 
     def _build_r2c(self):
         raise NotImplementedError
 
     def _build_c2r(self):
+        raise NotImplementedError
+
+    def _guard_spec(self, direction: str, dims: int = 3):
+        """The family's ``guards.GuardSpec`` for one direction (only
+        consulted at modes check/enforce)."""
+        raise NotImplementedError
+
+    def _wisdom_key_args(self) -> dict:
+        """Key components of this plan's wisdom entry (the fallback
+        ladder's demotion stamp targets the exact cell that failed)."""
         raise NotImplementedError
 
     # -- pure pipelines (compose under user transforms) --------------------
@@ -217,7 +243,7 @@ class DistFFTPlan:
             return local_fft.fft(c, axis=-3, norm=norm, backend=be,
                                  settings=st)
 
-        return jax.jit(run) if jit else run
+        return self._jit_guarded(run, "forward") if jit else run
 
     def _fft3d_c2r(self, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
@@ -243,7 +269,7 @@ class DistFFTPlan:
             ys = jnp.reshape(c, (ck, nx // ck) + c.shape[1:])
             return jnp.reshape(jax.lax.map(per, ys), (nx,) + shape[1:])
 
-        return jax.jit(run) if jit else run
+        return self._jit_guarded(run, "inverse") if jit else run
 
     def _fft3d_c2c(self, forward: bool, jit: bool = True):
         """Single-device full 3D C2C (both directions unnormalized under
@@ -257,7 +283,16 @@ class DistFFTPlan:
                 return local_fft.fftn(c, axes, norm=norm, backend=be, settings=st)
             return local_fft.ifftn(c, axes, norm=norm, backend=be, settings=st)
 
-        return jax.jit(run) if jit else run
+        if not jit:
+            return run
+        return self._jit_guarded(run, "forward" if forward else "inverse")
+
+    def _jit_guarded(self, run, direction: str):
+        """Jit a single-device pipeline with the guard wrapper applied at
+        modes check/enforce (``guards.maybe_wrap``; a no-op pass-through —
+        same callable, identical HLO — at "off")."""
+        run, _ = guards.maybe_wrap(self, run, direction)
+        return jax.jit(run)
 
     # -- staged-execution helper (shared by slab/pencil/batched2d) ---------
 

@@ -87,6 +87,11 @@ class Batched2DFFTPlan:
         self.config = config or pm.Config()
         # Settings snapshot at construction (see DistFFTPlan.__init__).
         self._mxu_st = self.config.mxu_settings()
+        # Resilience state (DistFFTPlan contract — this plan sits outside
+        # that hierarchy but honors the same guard/fallback envelope).
+        from ..resilience import guards as _guards
+        self._guard_mode = _guards.resolved_mode(self.config)
+        self._guard_state = {}
         self.mesh = mesh
         self.shard = shard
         self.transform = transform
@@ -124,6 +129,8 @@ class Batched2DFFTPlan:
                     f"padded batch {local_b}")
         self._fwd = None
         self._inv = None
+        self._fwd_unguarded = None  # staged path under guard modes
+        self._inv_unguarded = None
         self._fwd_pure = None
         self._inv_pure = None
         obs.event("plan.created", kind="batched2d", shard=shard,
@@ -202,9 +209,8 @@ class Batched2DFFTPlan:
         if tuple(x.shape) == self.input_shape \
                 and self.input_shape != self.input_padded_shape:
             x = self.pad_input(x)
-        if self._fwd is None:
-            self._fwd = self._build(forward=True)
-        return self._fwd(x)
+        from ..resilience import fallback
+        return fallback.execute(self, "forward", x, self._get_fwd, dims=2)
 
     def exec_inverse(self, c):
         """Batched 2D inverse transform."""
@@ -215,9 +221,50 @@ class Batched2DFFTPlan:
         if tuple(c.shape) == self.output_shape \
                 and self.output_shape != self.output_padded_shape:
             c = self.pad_spectral(c)
+        from ..resilience import fallback
+        return fallback.execute(self, "inverse", c, self._get_inv, dims=2)
+
+    def _get_fwd(self):
+        if self._fwd is None:
+            self._fwd = self._build(forward=True)
+        return self._fwd
+
+    def _get_inv(self):
         if self._inv is None:
             self._inv = self._build(forward=False)
-        return self._inv(c)
+        return self._inv
+
+    # -- resilience hooks (guards + fallback ladder) -----------------------
+
+    def _guard_spec(self, direction: str, dims: int = 2):
+        """GuardSpec of the batched-2D pipelines (slab contract): the
+        transform covers (x, y) of every plane, so the Parseval volume is
+        ``nx * ny`` and the R2C halved axis is the last slot."""
+        from ..resilience.guards import GuardSpec
+        norm = self.config.norm
+        n = float(self.nx * self.ny)
+        c2c = self.transform == "c2c"
+        if direction == "forward":
+            return GuardSpec(
+                direction="forward", check="parseval",
+                scale=1.0 if norm is pm.FFTNorm.ORTHO else n,
+                in_logical=self.input_shape,
+                out_logical=self.output_shape,
+                halved_axis=None if c2c else 2,
+                halved_n=0 if c2c else self.ny)
+        if not c2c:
+            return GuardSpec(direction="inverse", check="finite", scale=1.0,
+                             in_logical=self.output_shape,
+                             out_logical=self.input_shape)
+        scale = {pm.FFTNorm.NONE: n, pm.FFTNorm.BACKWARD: 1.0 / n,
+                 pm.FFTNorm.ORTHO: 1.0}[norm]
+        return GuardSpec(direction="inverse", check="parseval", scale=scale,
+                         in_logical=self.output_shape,
+                         out_logical=self.input_shape)
+
+    def _wisdom_key_args(self) -> dict:
+        return {"kind": "batched2d", "variant": self.shard,
+                "transform": self.transform, "dims": 2}
 
     # -- builders ----------------------------------------------------------
 
@@ -251,15 +298,24 @@ class Batched2DFFTPlan:
 
         return fn
 
-    def _build(self, forward: bool):
+    def _build(self, forward: bool, guard: bool = True):
         with obs.span("plan.build", kind="batched2d", shard=self.shard,
                       direction="forward" if forward else "inverse"):
+            from ..resilience import guards
+            direction = "forward" if forward else "inverse"
             pure, in_spec, out_spec = self._build_pure(forward)
+            guarded = False
+            if guard:
+                pure, guarded = guards.maybe_wrap(self, pure, direction,
+                                                  dims=2)
             if self.mesh is None:
                 return jax.jit(pure)
+            outsh = NamedSharding(self.mesh, out_spec)
+            if guarded:
+                outsh = (outsh, NamedSharding(self.mesh, PartitionSpec()))
             return jax.jit(pure,
                            in_shardings=NamedSharding(self.mesh, in_spec),
-                           out_shardings=NamedSharding(self.mesh, out_spec))
+                           out_shardings=outsh)
 
     def _build_pure(self, forward: bool):
         """(pure_fn, in_spec, out_spec) — the specs travel with the
@@ -454,8 +510,15 @@ class Batched2DFFTPlan:
     def forward_stages(self):
         """[(phase desc, jitted stage fn)] for per-phase timed execution
         (slab contract). Batch sharding has no collective, so its staged
-        path IS the fused program under one descriptive marker."""
+        path IS the fused program under one descriptive marker — built
+        UNGUARDED when guards are on (the staged loop threads raw arrays
+        between phases; the guard tuple belongs to the exec envelope)."""
         if self.fft3d or self.shard == "batch":
+            if self._guard_mode != "off":
+                if self._fwd_unguarded is None:
+                    self._fwd_unguarded = self._build(forward=True,
+                                                      guard=False)
+                return [("2D FFT X-Y-Direction", self._fwd_unguarded)]
             if self._fwd is None:
                 self._fwd = self._build(forward=True)
             return [("2D FFT X-Y-Direction", self._fwd)]
@@ -467,6 +530,11 @@ class Batched2DFFTPlan:
 
     def inverse_stages(self):
         if self.fft3d or self.shard == "batch":
+            if self._guard_mode != "off":
+                if self._inv_unguarded is None:
+                    self._inv_unguarded = self._build(forward=False,
+                                                      guard=False)
+                return [("2D FFT X-Y-Direction", self._inv_unguarded)]
             if self._inv is None:
                 self._inv = self._build(forward=False)
             return [("2D FFT X-Y-Direction", self._inv)]

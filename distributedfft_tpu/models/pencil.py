@@ -58,6 +58,7 @@ from ..parallel.transpose import (WIRE_NATIVE, all_to_all_transpose,
                                   pad_axis_to, ring_transpose, slice_axis_to,
                                   split_axis_chunks, wire_complex_dtype,
                                   wire_decode, wire_encode)
+from ..resilience import inject
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad
 
@@ -116,6 +117,9 @@ class PencilFFTPlan(DistFFTPlan):
         # compiled-callable caches keyed by dims
         self._r2c_d: Dict[int, object] = {}
         self._c2r_d: Dict[int, object] = {}
+        # The depth the wisdom entry was resolved under (the fallback
+        # ladder's demotion stamp must target the exact cell).
+        self._wisdom_dims = dims
         obs.event("plan.created", kind="pencil", transform=transform,
                   shape=list(g.shape), grid=[self.p1, self.p2],
                   comm=self.config.comm_method.value,
@@ -295,9 +299,14 @@ class PencilFFTPlan(DistFFTPlan):
         if not self.fft3d and tuple(x.shape) == self.input_shape \
                 and self.input_shape != self.input_padded_shape:
             x = self.pad_input(x)
-        if dims not in self._r2c_d:
-            self._r2c_d[dims] = self._build_r2c_d(dims)
-        return self._r2c_d[dims](x)
+        from ..resilience import fallback
+
+        def get():
+            if dims not in self._r2c_d:
+                self._r2c_d[dims] = self._build_r2c_d(dims)
+            return self._r2c_d[dims]
+
+        return fallback.execute(self, "forward", x, get, dims=dims)
 
     def _exec_inv(self, c, dims: int = 3):
         if dims not in (1, 2, 3):
@@ -310,9 +319,52 @@ class PencilFFTPlan(DistFFTPlan):
         if not self.fft3d and tuple(c.shape) == self.output_shape \
                 and self.output_shape != padded:
             c = self.pad_spectral(c, dims)
-        if dims not in self._c2r_d:
-            self._c2r_d[dims] = self._build_c2r_d(dims)
-        return self._c2r_d[dims](c)
+        from ..resilience import fallback
+
+        def get():
+            if dims not in self._c2r_d:
+                self._c2r_d[dims] = self._build_c2r_d(dims)
+            return self._c2r_d[dims]
+
+        return fallback.execute(self, "inverse", c, get, dims=dims)
+
+    # -- resilience hooks (guards + fallback ladder) -----------------------
+
+    def _transformed_volume(self, dims: int) -> float:
+        """Product of the transformed logical extents at depth ``dims``
+        (the Parseval scale; matches ``testcases._roundtrip_scale``)."""
+        g = self.global_size
+        return float({1: g.nz, 2: g.nz * g.ny, 3: g.n_total}[dims])
+
+    def _guard_spec(self, direction: str, dims: int = 3):
+        """GuardSpec per direction AND depth (slab contract; the partial-
+        dims programs conserve energy over exactly the transformed
+        axes)."""
+        from ..resilience.guards import GuardSpec
+        g, norm = self.global_size, self.config.norm
+        n = self._transformed_volume(dims)
+        c2c = self.transform == "c2c"
+        out_logical = (g.nx, g.ny, self._nz_spec)
+        if direction == "forward":
+            return GuardSpec(
+                direction="forward", check="parseval",
+                scale=1.0 if norm is pm.FFTNorm.ORTHO else n,
+                in_logical=self.input_shape, out_logical=out_logical,
+                halved_axis=None if c2c else 2,
+                halved_n=0 if c2c else g.nz)
+        if not c2c:
+            return GuardSpec(direction="inverse", check="finite", scale=1.0,
+                             in_logical=out_logical,
+                             out_logical=self.input_shape)
+        scale = {pm.FFTNorm.NONE: n, pm.FFTNorm.BACKWARD: 1.0 / n,
+                 pm.FFTNorm.ORTHO: 1.0}[norm]
+        return GuardSpec(direction="inverse", check="parseval", scale=scale,
+                         in_logical=out_logical,
+                         out_logical=self.input_shape)
+
+    def _wisdom_key_args(self) -> dict:
+        return {"kind": "pencil", "transform": self.transform,
+                "dims": self._wisdom_dims}
 
     # -- pipeline bodies ---------------------------------------------------
 
@@ -455,14 +507,16 @@ class PencilFFTPlan(DistFFTPlan):
                       dims=dims):
             if self.fft3d:
                 return self._fft3d_r2c_d(dims)
-            return self._compile(*self._fwd_segments(dims))
+            return self._compile(*self._fwd_segments(dims),
+                                 direction="forward", dims=dims)
 
     def _build_c2r_d(self, dims: int):
         with obs.span("plan.build", kind="pencil", direction="inverse",
                       dims=dims):
             if self.fft3d:
                 return self._fft3d_c2r_d(dims)
-            return self._compile(*self._inv_segments(dims))
+            return self._compile(*self._inv_segments(dims),
+                                 direction="inverse", dims=dims)
 
     def forward_fn(self, dims: int = 3):
         """Pure forward pipeline (``DistFFTPlan.forward_fn`` contract);
@@ -658,9 +712,12 @@ class PencilFFTPlan(DistFFTPlan):
             with the decode, so the GSPMD boundary collective between them
             moves the planar bf16 array (specs gain the leading plane
             axis). Returns the encoded next-stage spec (the boundary's
-            target layout, for the chunked reshard's NamedSharding)."""
+            target layout, for the chunked reshard's NamedSharding). The
+            fault-injection taint sits after the encode — the corrupted
+            wire image is what the boundary collective moves."""
             nonlocal cur_fns, cur_in, cur_out
-            cur_fns.append(lambda c: wire_encode(c, wire))
+            cur_fns.append(
+                lambda c: inject.taint_wire(wire_encode(c, wire), "gspmd"))
             cur_out = PartitionSpec(None, *cur_out)
             flush()
             cur_fns = [lambda y: wire_decode(y, cdt, wire)]
@@ -670,6 +727,11 @@ class PencilFFTPlan(DistFFTPlan):
 
         for fn, spec in segments:
             if fn == "BREAK":
+                # Native GSPMD boundary: the stage's output IS the wire
+                # payload; the injection taint (identity without
+                # $DFFT_FAULT_SPEC) closes the stage.
+                if cur_fns:
+                    cur_fns.append(lambda c: inject.taint_wire(c, "gspmd"))
                 flush()
                 cur_fns = []
                 cur_in = spec
@@ -723,13 +785,21 @@ class PencilFFTPlan(DistFFTPlan):
 
         return run, segments[-1][1]
 
-    def _compile(self, segments, in_spec):
-        """Jit the pure composition with in/out shardings."""
+    def _compile(self, segments, in_spec, direction: str = "forward",
+                 dims: int = 3):
+        """Jit the pure composition with in/out shardings; at guard modes
+        check/enforce the jitted program is the guarded pipeline
+        ``x -> (y, stats)`` (slab ``_assemble`` contract)."""
+        from ..resilience import guards
         run, out_spec = self._compose(segments, in_spec)
         mesh = self.mesh
+        run, guarded = guards.maybe_wrap(self, run, direction, dims)
+        outsh = NamedSharding(mesh, out_spec)
+        if guarded:
+            outsh = (outsh, NamedSharding(mesh, PartitionSpec()))
         return jax.jit(run,
                        in_shardings=NamedSharding(mesh, in_spec),
-                       out_shardings=NamedSharding(mesh, out_spec))
+                       out_shardings=outsh)
 
     # -- single-device partial-dim fallbacks ------------------------------
 
@@ -749,7 +819,11 @@ class PencilFFTPlan(DistFFTPlan):
                 c = lf.fft(c, axis=0, norm=norm, backend=be, settings=st)
             return c
 
-        return jax.jit(run) if jit else run
+        if not jit:
+            return run
+        from ..resilience import guards
+        run, _ = guards.maybe_wrap(self, run, "forward", dims)
+        return jax.jit(run)
 
     def _fft3d_c2r_d(self, dims: int, jit: bool = True):
         norm, be = self.config.norm, self.config.fft_backend
@@ -766,5 +840,9 @@ class PencilFFTPlan(DistFFTPlan):
                 return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
             return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be, settings=st)
 
-        return jax.jit(run) if jit else run
+        if not jit:
+            return run
+        from ..resilience import guards
+        run, _ = guards.maybe_wrap(self, run, "inverse", dims)
+        return jax.jit(run)
 

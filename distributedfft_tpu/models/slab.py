@@ -251,9 +251,8 @@ class SlabFFTPlan(DistFFTPlan):
         if not self.fft3d and tuple(x.shape) == self.input_shape \
                 and self.input_shape != self.input_padded_shape:
             x = self.pad_input(x)
-        if self._r2c is None:
-            self._r2c = self._build_r2c()
-        return self._r2c(x)
+        from ..resilience import fallback
+        return fallback.execute(self, "forward", x, self._get_r2c)
 
     def _exec_inv(self, c):
         if tuple(c.shape) not in (self.output_shape, self.output_padded_shape):
@@ -263,9 +262,44 @@ class SlabFFTPlan(DistFFTPlan):
         if not self.fft3d and tuple(c.shape) == self.output_shape \
                 and self.output_shape != self.output_padded_shape:
             c = self.pad_spectral(c)
-        if self._c2r is None:
-            self._c2r = self._build_c2r()
-        return self._c2r(c)
+        from ..resilience import fallback
+        return fallback.execute(self, "inverse", c, self._get_c2r)
+
+    # -- resilience hooks (guards + fallback ladder) -----------------------
+
+    def _guard_spec(self, direction: str, dims: int = 3):
+        """GuardSpec of the slab pipelines (``resilience/guards.py``):
+        forward = Parseval with the sequence's R2C axis weighted (plain
+        for c2c); inverse = Parseval for c2c (exact for any input),
+        finiteness for C2R (arbitrary spectral input is not conjugate-
+        symmetric — the transform projects it, so energy is not an
+        invariant of that direction)."""
+        from ..resilience.guards import GuardSpec
+        g, norm = self.global_size, self.config.norm
+        n = float(g.n_total)
+        c2c = self.transform == "c2c"
+        if direction == "forward":
+            return GuardSpec(
+                direction="forward", check="parseval",
+                scale=1.0 if norm is pm.FFTNorm.ORTHO else n,
+                in_logical=self.input_shape,
+                out_logical=self._spec_shape,
+                halved_axis=None if c2c else self._seq.r2c_axis,
+                halved_n=0 if c2c else (g.nz if self._seq.halved == "z"
+                                        else g.ny))
+        if not c2c:
+            return GuardSpec(direction="inverse", check="finite", scale=1.0,
+                             in_logical=self.output_shape,
+                             out_logical=self.input_shape)
+        scale = {pm.FFTNorm.NONE: n, pm.FFTNorm.BACKWARD: 1.0 / n,
+                 pm.FFTNorm.ORTHO: 1.0}[norm]
+        return GuardSpec(direction="inverse", check="parseval", scale=scale,
+                         in_logical=self.output_shape,
+                         out_logical=self.input_shape)
+
+    def _wisdom_key_args(self) -> dict:
+        return {"kind": "slab", "sequence": self.sequence,
+                "transform": self.transform, "dims": 3}
 
     # -- pipeline bodies ---------------------------------------------------
     # Three reusable local bodies per direction. The fused builders compose
@@ -566,12 +600,22 @@ class SlabFFTPlan(DistFFTPlan):
     def _assemble(self, parts, in_spec, out_spec, comm: pm.CommMethod,
                   forward: bool = True):
         """Compose (first, xpose, last) into one jitted program (the pure
-        composition from ``_assemble_pure`` with in/out shardings)."""
+        composition from ``_assemble_pure`` with in/out shardings). At
+        guard modes check/enforce the program is the GUARDED pipeline
+        ``x -> (y, stats)`` (``resilience/guards.py``: the Parseval/drift
+        reductions traced into the same jit); at "off" it is byte-
+        identical to the pre-guard program."""
+        from ..resilience import guards
         pure = self._assemble_pure(parts, in_spec, out_spec, comm,
                                    forward=forward)
         mesh = self.mesh
+        pure, guarded = guards.maybe_wrap(
+            self, pure, "forward" if forward else "inverse")
+        outsh = NamedSharding(mesh, out_spec)
+        if guarded:
+            outsh = (outsh, NamedSharding(mesh, PartitionSpec()))
         return jax.jit(pure, in_shardings=NamedSharding(mesh, in_spec),
-                       out_shardings=NamedSharding(mesh, out_spec))
+                       out_shardings=outsh)
 
     def _assemble_pure(self, parts, in_spec, out_spec, comm: pm.CommMethod,
                        forward: bool = True):

@@ -72,6 +72,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--wire-dtype", "-wire", default="native",
                     choices=("native", "bf16", "auto"))
     ap.add_argument("--wire-error-budget", type=float, default=None)
+    ap.add_argument("--guards", default=None,
+                    choices=("off", "check", "enforce"),
+                    help="explain the plan's resilience posture under this "
+                         "guard mode (default: $DFFT_GUARDS -> off)")
     ap.add_argument("--fft-backend", default="xla")
     ap.add_argument("--double_prec", "-d", action="store_true")
     ap.add_argument("--c2c", action="store_true",
@@ -174,6 +178,57 @@ def _wisdom_lines(prov) -> list:
     return lines
 
 
+def _resilience_lines(plan, cfg, prov) -> list:
+    """Resilience posture: guard mode + derived tolerances, the fallback
+    ladder that WOULD apply to this rendering, and any wisdom demotion
+    stamps on the resolved cell (all static — nothing executes)."""
+    import numpy as np
+
+    from ..resilience import fallback, guards
+    from ..utils import wisdom
+
+    mode = plan._guard_mode
+    src = ("Config.guards" if cfg.guards is not None
+           else ("$DFFT_GUARDS" if mode != "off" else "default"))
+    lines = [f"  guards: {mode} ({src})"]
+    fwd = plan._guard_spec("forward")
+    inv = plan._guard_spec("inverse")
+    n = int(np.prod(fwd.in_logical))
+    tol = guards.parseval_tolerance(cfg.double_prec, cfg.wire_dtype, n)
+    dt = "f64" if cfg.double_prec else "f32"
+    lines.append(f"  forward check: parseval, tolerance {tol:.2e} "
+                 f"(dtype {dt}, wire {cfg.wire_dtype}, N={n})")
+    lines.append(f"  inverse check: {inv.check}"
+                 + ("" if inv.check == "parseval" else
+                    " (C2R: arbitrary spectral input is not conjugate-"
+                    "symmetric, so energy is not an invariant there)"))
+    if cfg.wire_dtype != "native":
+        lines.append(f"  wire drift probe: budget "
+                     f"{cfg.resolved_wire_budget():.0e} "
+                     "(one extra encode/decode of the spectral payload)")
+    ladder = fallback.ladder_preview(cfg)
+    if ladder:
+        steps = " -> ".join(f"[{r}] {lbl}" for r, lbl in ladder)
+        lines.append(f"  fallback ladder: {steps} -> error propagates")
+    else:
+        lines.append("  fallback ladder: none (default rendering — "
+                     "failures propagate, never retried)")
+    store = wisdom.store_for_config(cfg)
+    stamps = []
+    if store is not None:
+        for slot in ("comm", "wire"):
+            rec = store.lookup(prov["key"], slot)
+            if rec and rec.get("demoted"):
+                stamps.append(
+                    f"  demotion stamp [{slot}]: rung "
+                    f"{rec.get('demoted_rung')} at "
+                    f"{rec.get('demoted_at', '?')} — "
+                    f"{rec.get('demoted_reason', '')[:80]} (record reads "
+                    "as a miss; next race re-records)")
+    lines += stamps if stamps else ["  demotion stamps: none"]
+    return lines
+
+
 def _roofline_lines(args, kind: str, backend: str) -> list:
     """Roofline expectation for the explained workload (cube / batched-2D
     only — the shapes the MAC model covers)."""
@@ -256,6 +311,7 @@ def main(argv=None) -> int:
         streams_chunks=args.streams_chunks,
         wire_dtype=pm.parse_wire_dtype(args.wire_dtype),
         wire_error_budget=args.wire_error_budget,
+        guards=args.guards,
         wisdom_path=args.wisdom, use_wisdom=not args.no_wisdom)
 
     if kind == "pencil":
@@ -403,6 +459,9 @@ def main(argv=None) -> int:
 
         out.append("wisdom:")
         out.extend(_wisdom_lines(prov))
+
+        out.append("resilience:")
+        out.extend(_resilience_lines(plan, cfg, prov))
 
         if not args.no_compile:
             out.append("hlo census (forward program, compiled, "

@@ -31,6 +31,21 @@ hlo.collective_permute     gauge   ``hlo.async_total`` is the async-start
 hlo.collective_permute_start gauge sum — the overlap detector)
 hlo.async_total            gauge
 hlo.convert                gauge
+guard.parseval_violations  counter energy/finiteness guard failures
+guard.wire_drift_violations counter wire drift probe over the error budget
+fallback.demotions         counter fallback-ladder rungs walked (total)
+fallback.<rung>_demotions  counter per-rung (send/opt/comm/wire)
+wisdom.demotion_stamps     counter records stamped demoted after failures
+wisdom.lock_breaks         counter stale advisory locks broken (age-based)
+wisdom.lock_timeouts       counter lock waits expired (write went unlocked)
+multihost.connect_retries  counter coordinator connect attempts retried
+autotune.cell_timeouts     counter race cells abandoned on wall-clock
+selftest.runs              counter --selftest roundtrips executed
+selftest.failures          counter --selftest FAIL lines
+inject.wire_faults         counter wire faults injected into traced programs
+inject.coordinator_failures counter simulated coordinator connect failures
+inject.lock_contentions    counter simulated held-lock reads
+inject.cell_hangs          counter simulated hung race cells
 ========================== ======= ==========================================
 
 Counters accumulate until ``reset()`` (tests reset between plans); gauges

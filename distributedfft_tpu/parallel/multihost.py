@@ -31,11 +31,16 @@ analog).
 from __future__ import annotations
 
 import os
+import random
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 import jax
+
+from .. import obs
+from ..resilience import inject
 
 _INITIALIZED = False
 
@@ -44,6 +49,49 @@ _INITIALIZED = False
 ENV_COORD = "DFFT_COORDINATOR"      # "host:port" of process 0
 ENV_NPROCS = "DFFT_NUM_PROCESSES"
 ENV_PROCID = "DFFT_PROCESS_ID"
+
+
+def _connect_with_backoff(connect, what: str):
+    """Bounded exponential backoff with jitter around the coordinator
+    connect (resilience leg 4): ``jax.distributed.initialize`` fails
+    outright when the coordinator is not yet listening — routine when a
+    pod's hosts start seconds apart, or the coordinator restarts — and
+    the old behavior turned that race into a crashed worker. Up to
+    ``$DFFT_COORD_RETRIES`` attempts (default 5), delays
+    ``$DFFT_COORD_BACKOFF_S`` * 2^attempt (default 0.5 s base) capped at
+    ``$DFFT_COORD_BACKOFF_CAP_S`` (default 30 s), each with +-25% jitter
+    so a restarted pod's workers do not reconnect in lockstep. The final
+    failure propagates — a coordinator that stays down must fail loudly,
+    not hang (``coordinator:down`` in ``$DFFT_FAULT_SPEC`` simulates
+    exactly this, ``resilience/inject.py``). Retries count into
+    ``multihost.connect_retries``."""
+    attempts = max(1, int(os.environ.get("DFFT_COORD_RETRIES", "5")))
+    base = float(os.environ.get("DFFT_COORD_BACKOFF_S", "0.5"))
+    cap = float(os.environ.get("DFFT_COORD_BACKOFF_CAP_S", "30"))
+    last = None
+    for attempt in range(attempts):
+        try:
+            inject.maybe_fail_coordinator(attempt)
+            return connect()
+        except (ConnectionError, OSError, TimeoutError, RuntimeError) as e:
+            # Only connection-shaped failures retry (jax surfaces grpc
+            # rendezvous errors as RuntimeError/XlaRuntimeError);
+            # deterministic configuration errors (ValueError/TypeError)
+            # propagate immediately — retrying them only delays and
+            # mislabels the real mistake as a network problem.
+            last = e
+            if attempt == attempts - 1:
+                break
+            delay = min(cap, base * (2 ** attempt))
+            delay *= 0.75 + 0.5 * random.random()  # +-25% jitter
+            obs.metrics.inc("multihost.connect_retries")
+            obs.notice(
+                f"multihost: {what} failed ({type(e).__name__}: {e}); "
+                f"retry {attempt + 2}/{attempts} in {delay:.2f}s",
+                name="multihost.connect_retry", attempt=attempt + 1,
+                attempts=attempts, delay_s=round(delay, 3))
+            time.sleep(delay)
+    raise last
 
 
 def maybe_initialize(coordinator_address: Optional[str] = None,
@@ -89,11 +137,15 @@ def maybe_initialize(coordinator_address: Optional[str] = None,
         return jax.process_index(), jax.process_count()
     if not _INITIALIZED:
         if coordinator_address:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
+            _connect_with_backoff(
+                lambda: jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id),
+                f"rendezvous with {coordinator_address}")
         else:
-            jax.distributed.initialize()  # autodetect (TPU pod metadata)
+            # autodetect (TPU pod metadata)
+            _connect_with_backoff(lambda: jax.distributed.initialize(),
+                                  "autodetected rendezvous")
         _INITIALIZED = True
     return jax.process_index(), jax.process_count()
 

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import obs
+from ..resilience import inject
 
 # Wire dtypes of the global exchanges (the "wire layer"): how a complex
 # shard is encoded immediately before a collective and decoded immediately
@@ -114,16 +115,20 @@ def wire_gspmd_stages(mesh, first, last, in_spec, out_spec, wire: str,
     from jax.sharding import PartitionSpec
 
     if wire == WIRE_NATIVE:
-        stage1 = jax.shard_map(first, mesh=mesh, in_specs=in_spec,
-                               out_specs=in_spec)
+        # inject.taint_wire: the fault-injection hook on the boundary
+        # payload — identity (zero added ops) without $DFFT_FAULT_SPEC.
+        stage1 = jax.shard_map(
+            lambda xl: inject.taint_wire(first(xl), "gspmd"),
+            mesh=mesh, in_specs=in_spec, out_specs=in_spec)
         stage2 = jax.shard_map(last, mesh=mesh, in_specs=out_spec,
                                out_specs=out_spec)
         return stage1, stage2, out_spec, 0
     cdt = wire_complex_dtype(double_prec)
     enc1 = PartitionSpec(None, *in_spec)
     enc2 = PartitionSpec(None, *out_spec)
-    stage1 = jax.shard_map(lambda xl: wire_encode(first(xl), wire),
-                           mesh=mesh, in_specs=in_spec, out_specs=enc1)
+    stage1 = jax.shard_map(
+        lambda xl: inject.taint_wire(wire_encode(first(xl), wire), "gspmd"),
+        mesh=mesh, in_specs=in_spec, out_specs=enc1)
     stage2 = jax.shard_map(lambda yl: last(wire_decode(yl, cdt, wire)),
                            mesh=mesh, in_specs=enc2, out_specs=out_spec)
     return stage1, stage2, enc2, 1
@@ -353,6 +358,10 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
         b = chunk(t)
         if wired:
             b = wire_encode(b, wire)
+        # Fault-injection hook on each TRAVELLING block (the local block
+        # never touches the wire, mirroring the encoding contract above);
+        # identity without $DFFT_FAULT_SPEC.
+        b = inject.taint_wire(b, "ring")
         b = lax.ppermute(b, axis_name, perm)
         if wired:
             b = wire_decode(b, x.dtype, wire)
@@ -430,12 +439,17 @@ def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
                       wire_nbytes(x.shape, x.dtype, wire))
     with obs.span("exchange.all_to_all", axis=axis_name,
                   realigned=bool(realigned), wire=wire):
+        # inject.taint_wire sits exactly at the wire_encode/wire_decode
+        # boundary: the corrupted image is what travels (and what the
+        # guards must catch). Identity without $DFFT_FAULT_SPEC.
         if _wire_active(x, wire):
             y = wire_encode(x, wire)
+            y = inject.taint_wire(y, "all_to_all")
             y = _all_to_all_native(y, axis_name, split_axis + 1,
                                    concat_axis + 1, realigned)
             return wire_decode(y, x.dtype, wire)
-        return _all_to_all_native(x, axis_name, split_axis, concat_axis,
+        return _all_to_all_native(inject.taint_wire(x, "all_to_all"),
+                                  axis_name, split_axis, concat_axis,
                                   realigned)
 
 

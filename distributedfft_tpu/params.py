@@ -52,6 +52,22 @@ from .utils import native_planner
 # set so params.py stays importable without jax.
 _MXU_PRECISIONS = frozenset({"default", "high", "highest"})
 
+# Valid Config.guards modes (resilience/guards.py): "off" = the exact
+# pre-guard programs (pinned byte-identical by tests/test_resilience.py),
+# "check" = compute the in-graph energy/drift guards and REPORT violations
+# (obs metrics + notice; a compressed wire additionally demotes itself to
+# native for subsequent calls), "enforce" = raise a structured
+# ``resilience.GuardViolation`` carrying the plan fingerprint.
+GUARD_MODES = ("off", "check", "enforce")
+
+
+def parse_guards(s: str) -> str:
+    """Canonical guard-mode name (case-insensitive)."""
+    key = str(s).strip().lower()
+    if key in GUARD_MODES:
+        return key
+    raise ValueError(f"unknown guards mode: {s!r} (choose from {GUARD_MODES})")
+
 # Marker for measurement-resolved Config fields: ``fft_backend=AUTO`` /
 # ``comm_method=AUTO`` ask the plan constructors to consult the persistent
 # wisdom store (``utils/wisdom.py``) and race-and-record on a miss. Plans
@@ -370,6 +386,16 @@ class Config:
     the RING ppermute ring, which encodes per travelling block so
     compression and overlap stack. Applies to both pencil transposes.
 
+    ``guards`` selects the in-graph numerical guards of the resilience
+    layer (``resilience/guards.py``; CLI ``--guards``, env
+    ``$DFFT_GUARDS``): ``None`` defers to the environment (unset = "off",
+    the exact pre-guard programs); ``"check"`` adds a Parseval/energy-
+    conservation residual (and, on a compressed wire, a drift probe
+    against ``wire_error_budget``) to every jitted pipeline — one extra
+    reduction, violations counted/noticed, a drifting wire demoted to
+    native for subsequent calls; ``"enforce"`` raises a structured
+    ``resilience.GuardViolation`` instead.
+
     ``fft3d_chunk`` bounds the SINGLE-DEVICE 3D path's peak memory: the
     z+y stages run as ``lax.map`` over that many leading-axis chunks, so
     the four-step relayout temporaries scale with a chunk instead of the
@@ -416,6 +442,7 @@ class Config:
     streams_chunks: Optional[int] = None
     wire_dtype: str = "native"
     wire_error_budget: Optional[float] = None
+    guards: Optional[str] = None
     wisdom_path: Optional[str] = None
     use_wisdom: bool = True
 
@@ -469,6 +496,10 @@ class Config:
             raise ValueError(
                 f"wire_error_budget must be a positive number or None, "
                 f"got {self.wire_error_budget!r}")
+        if self.guards is not None:
+            # Canonicalized here rather than at resolution so a typo'd
+            # mode fails at Config construction, not at first exec.
+            object.__setattr__(self, "guards", parse_guards(self.guards))
 
     def mxu_settings(self):
         """The plan's ``mxu_fft.MXUSettings``, or None when every knob is
@@ -514,3 +545,14 @@ class Config:
         wire (None -> DEFAULT_WIRE_ERROR_BUDGET)."""
         return (self.wire_error_budget if self.wire_error_budget is not None
                 else DEFAULT_WIRE_ERROR_BUDGET)
+
+    def resolved_guards(self) -> str:
+        """Guard mode: the explicit ``guards`` field, else ``$DFFT_GUARDS``,
+        else "off". Read once at plan construction (resilience/guards.py),
+        so a mid-run env change cannot split a plan's directions across
+        modes."""
+        if self.guards is not None:
+            return self.guards
+        import os
+        env = os.environ.get("DFFT_GUARDS", "").strip()
+        return parse_guards(env) if env else "off"

@@ -1,0 +1,33 @@
+"""Resilience layer: numerical guards, fault injection, graceful fallback.
+
+Four coordinated legs (ISSUE 5), none of which may perturb a compiled
+program in its default-off state — ``tests/test_resilience.py`` pins the
+HLO byte-identical with ``guards="off"`` and ``$DFFT_FAULT_SPEC`` unset:
+
+* ``guards``   — in-graph Parseval/energy-conservation + wire-drift
+  checks (``Config(guards="off|check|enforce")`` / ``--guards`` /
+  ``$DFFT_GUARDS``), raising structured ``GuardViolation`` in enforce
+  mode.
+* ``inject``   — deterministic, seed-keyed fault injectors (wire payload
+  corruption, coordinator unavailability, stale wisdom locks, hung
+  autotune cells) active only under ``$DFFT_FAULT_SPEC``.
+* ``fallback`` — the graceful-degradation ladder (ring/streams -> opt1 ->
+  default -> All2All; bf16 -> native) with wisdom demotion stamps.
+* ``selftest`` — the CLI ``--selftest`` guarded roundtrip (imported on
+  demand: it pulls in the testcase harness, which this package root must
+  not).
+
+Host-side retry/timeout/backoff (wisdom lock breaking, coordinator
+connect backoff, autotune cell timeouts) lives with the machinery it
+protects (``utils/wisdom.py``, ``parallel/multihost.py``,
+``testing/autotune.py``) and reports through the same ``obs`` metrics.
+"""
+
+from . import fallback, guards, inject
+from .guards import GuardViolation, parseval_tolerance
+from .inject import FaultSpec, parse_fault_spec
+
+__all__ = [
+    "FaultSpec", "GuardViolation", "fallback", "guards", "inject",
+    "parse_fault_spec", "parseval_tolerance",
+]

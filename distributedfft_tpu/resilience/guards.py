@@ -1,0 +1,301 @@
+"""In-graph numerical guards: cheap invariants that catch silent corruption.
+
+The framework's validation story (testcases 1/3/4, tests/) runs OFFLINE; a
+production run has no reference to compare against, so a flipped bit on the
+wire, a NaN from a bad lowering, or a compressed exchange drifting past its
+error budget all produce a silently wrong answer. These guards are the
+online complement: invariants of the transform itself, computed INSIDE the
+jitted plan (one extra reduction — for the slab/batched explicit renderings
+no extra collective beyond the scalar all-reduce GSPMD folds into the
+reduction), checked on the host after each execution.
+
+Two checks per pipeline:
+
+* **Parseval / energy conservation** — for an unnormalized forward
+  transform of logical volume ``N``, ``||X||^2 == N * ||x||^2`` exactly
+  (in exact arithmetic); R2C halves one axis, so the spectral energy is
+  reconstructed with the standard conjugate-symmetry weights (DC and — for
+  even extents — Nyquist bins count once, interior bins twice). The check
+  holds for ANY input, so it runs on production data, not probes. The C2C
+  inverse satisfies the mirrored identity for any input; the C2R inverse
+  does NOT (arbitrary spectral input is not conjugate-symmetric — the
+  transform projects it), so that direction degrades to a finiteness
+  guard (which still catches every NaN/Inf-producing fault).
+* **Wire drift probe** — under a compressed wire, one extra
+  encode->decode of the spectral payload measures the ACTUAL max relative
+  drift a wire crossing induces on this data (bf16's rounding depends on
+  the data's dynamic range) and compares it against
+  ``Config.wire_error_budget``.
+
+Modes (``Config.guards`` -> ``$DFFT_GUARDS`` -> "off"):
+
+* ``off``     — the exact pre-guard programs, byte-identical HLO (pinned).
+* ``check``   — violations increment ``guard.parseval_violations`` /
+  ``guard.wire_drift_violations``, emit ``obs.notice``, and a violating
+  compressed wire demotes itself to native for subsequent calls
+  (``fallback.demote_wire``).
+* ``enforce`` — violations raise ``GuardViolation`` carrying the plan
+  fingerprint (kind, shape, rendering, wire, backend, direction).
+
+Tolerance is derived from the dtype and wire (``parseval_tolerance``):
+float rounding accumulates like ``eps * log2(N)`` through an FFT + a sum
+reduction, and a bf16 wire adds its documented per-crossing energy drift.
+The derivation errs loose — a guard that cries wolf on healthy runs would
+be disabled and then catch nothing — while every injected fault class
+(NaN, exponent bit-flip, payload scaling) lands orders of magnitude above
+it (tests/test_resilience.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+
+# Floor of the relative-residual denominator (an all-zero input has zero
+# energy on both sides; 0/tiny -> residual 0, not NaN).
+_TINY = 1e-30
+
+
+class GuardViolation(RuntimeError):
+    """A numerical guard fired in ``enforce`` mode. Carries the check
+    name, measured value, tolerance and the plan fingerprint so the
+    failure is attributable without a debugger."""
+
+    def __init__(self, check: str, value: float, tolerance: float,
+                 fingerprint: dict):
+        self.check = check
+        self.value = value
+        self.tolerance = tolerance
+        self.fingerprint = dict(fingerprint)
+        super().__init__(
+            f"guard violation: {check} residual {value:.3e} exceeds "
+            f"tolerance {tolerance:.3e} on {fingerprint}")
+
+
+def resolved_mode(config) -> str:
+    """The guard mode a Config selects (field -> $DFFT_GUARDS -> off)."""
+    return config.resolved_guards()
+
+
+def parseval_tolerance(double_prec: bool, wire_dtype: str,
+                       n_total: int) -> float:
+    """Max acceptable relative Parseval residual for a transform of
+    logical volume ``n_total`` in the given precision over the given wire.
+
+    Float term: rounding through an FFT stage accumulates like
+    ``eps * log2(N)`` per element and again through the energy reduction;
+    64x headroom keeps healthy runs (measured ~1e-6 relative at 256^3
+    f32) an order of magnitude clear. Wire term: a bf16 crossing carries
+    a <= 2e-2 documented per-element bound with ~2e-3 typical rel error
+    (README 'wire dtype'); the energy residual of an elementwise rel
+    error d is ~2d, and a pencil forward crosses twice — 0.1 covers both
+    crossings at the documented bound with margin. Injected faults (NaN,
+    exponent bit-flip, 0.5x payload scale) land at inf / >1e30 / ~0.75
+    respectively — far above either term."""
+    eps = 2.3e-16 if double_prec else 1.2e-7
+    tol = 64.0 * eps * max(1.0, math.log2(max(2, int(n_total))))
+    if wire_dtype != "native":
+        tol += 0.1
+    return tol
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardSpec:
+    """Static description of one direction's guard (built by the plan
+    family's ``_guard_spec``): which check applies, the expected
+    out/in energy ratio under the plan's norm, the logical extents the
+    padded global arrays are sliced to before the reduction, and the R2C
+    halved-axis weighting of the spectral (output) side."""
+
+    direction: str               # "forward" | "inverse"
+    check: str                   # "parseval" | "finite"
+    scale: float                 # expected ||out||^2 / ||in||^2
+    in_logical: Tuple[int, ...]
+    out_logical: Tuple[int, ...]
+    halved_axis: Optional[int] = None  # forward R2C only (output side)
+    halved_n: int = 0                  # pre-halving logical extent
+
+
+@dataclasses.dataclass
+class GuardState:
+    """Per-(direction, dims) host-side check state stashed on the plan at
+    build time, so ``finish`` compares against exactly the tolerances the
+    traced program was built under."""
+
+    spec: GuardSpec
+    tolerance: float
+    wire_budget: float
+    probe: bool                  # wire drift probe traced into the program
+
+
+def _halved_weights(padded_ext: int, halved_n: int):
+    """Conjugate-symmetry energy weights of an R2C halved axis of padded
+    extent ``padded_ext`` (pre-halving logical extent ``halved_n``): DC
+    counts once, the Nyquist bin once when ``halved_n`` is even, interior
+    bins twice, pad lanes zero. A static numpy constant — XLA folds it."""
+    nh = halved_n // 2 + 1
+    w = np.zeros(padded_ext, dtype=np.float32)
+    w[:nh] = 2.0
+    w[0] = 1.0
+    if halved_n % 2 == 0:
+        w[nh - 1] = 1.0
+    return w
+
+
+def _slice_logical(v, logical: Tuple[int, ...]):
+    """Leading-slice every axis to its logical extent (pad lanes of a
+    padded global array may carry junk — multi-host inputs fill the whole
+    padded box — and must not count as energy)."""
+    from jax import lax
+    for ax, n in enumerate(logical):
+        if v.shape[ax] != n:
+            v = lax.slice_in_dim(v, 0, n, axis=ax)
+    return v
+
+
+def _energy(v, halved_axis: Optional[int], halved_n: int):
+    import jax.numpy as jnp
+    a2 = jnp.real(v) ** 2 + jnp.imag(v) ** 2 if jnp.iscomplexobj(v) \
+        else v * v
+    if halved_axis is not None:
+        w = _halved_weights(v.shape[halved_axis], halved_n)
+        shape = [1] * v.ndim
+        shape[halved_axis] = v.shape[halved_axis]
+        a2 = a2 * jnp.asarray(w).reshape(shape)
+    return jnp.sum(a2)
+
+
+def wrap(pure, spec: GuardSpec, wire: str, probe: bool):
+    """The guarded pipeline: ``x -> (y, stats)`` where ``stats`` is a
+    float32 2-vector ``[check_residual, wire_drift]`` (drift -1 when not
+    probed). All guard ops are global-view inside the same jit as the
+    pipeline, so GSPMD shards the elementwise work and folds the scalar
+    all-reduce into the reduction."""
+    import jax.numpy as jnp
+
+    from ..parallel.transpose import wire_decode, wire_encode
+
+    def run(x):
+        y = pure(x)
+        if spec.check == "finite":
+            e = jnp.sum(jnp.real(y) ** 2 + jnp.imag(y) ** 2
+                        if jnp.iscomplexobj(y) else y * y)
+            resid = jnp.where(jnp.isfinite(e), 0.0, jnp.inf)
+        else:
+            in_e = _energy(_slice_logical(x, spec.in_logical), None, 0)
+            out_e = _energy(_slice_logical(y, spec.out_logical),
+                            spec.halved_axis, spec.halved_n)
+            expected = spec.scale * in_e  # Python float: weak-typed scalar
+            resid = jnp.abs(out_e - expected) / jnp.maximum(
+                jnp.abs(expected), _TINY)
+        if probe:
+            # Drift probe on the spectral-side payload (what the wire
+            # carried): forward probes the output, inverse the input.
+            v = y if spec.direction == "forward" else x
+            z = wire_decode(wire_encode(v, wire), v.dtype, wire)
+            drift = jnp.max(jnp.abs(z - v)) / jnp.maximum(
+                jnp.max(jnp.abs(v)), _TINY)
+        else:
+            drift = jnp.asarray(-1.0)
+        stats = jnp.stack([resid.astype(jnp.float32),
+                           drift.astype(jnp.float32)])
+        return y, stats
+
+    return run
+
+
+def maybe_wrap(plan, pure, direction: str, dims: int = 3):
+    """``(pipeline, guarded)``: the guarded wrapper at modes check/enforce
+    (stashing the host-side ``GuardState`` on the plan), the pipeline
+    unchanged — same object, zero added ops — at "off"."""
+    mode = getattr(plan, "_guard_mode", "off")
+    if mode == "off":
+        return pure, False
+    spec = plan._guard_spec(direction, dims)
+    cfg = plan.config
+    wire = cfg.wire_dtype
+    probe = wire != "native"
+    n_total = int(np.prod(spec.in_logical))
+    state = GuardState(
+        spec=spec,
+        tolerance=parseval_tolerance(cfg.double_prec, wire, n_total),
+        wire_budget=cfg.resolved_wire_budget(),
+        probe=probe)
+    plan._guard_state[(direction, dims)] = state
+    return wrap(pure, spec, wire, probe), True
+
+
+def fingerprint(plan, direction: str) -> dict:
+    """The plan identity a violation carries: enough to reproduce the
+    failing configuration from a log line alone."""
+    cfg = plan.config
+    fp = {
+        "plan": type(plan).__name__,
+        "variant": getattr(plan, "variant_name", None),
+        "shape": list(plan.global_size.shape),
+        "ranks": plan.partition.num_ranks,
+        "transform": getattr(plan, "transform", "r2c"),
+        "direction": direction,
+        "comm": cfg.comm_method.value,
+        "send": cfg.send_method.value,
+        "opt": cfg.opt,
+        "wire": cfg.wire_dtype,
+        "backend": cfg.fft_backend,
+        "double_prec": cfg.double_prec,
+    }
+    seq = getattr(plan, "sequence", None)
+    if seq is not None:
+        fp["sequence"] = seq.value
+    return fp
+
+
+def finish(plan, out, direction: str, dims: int = 3):
+    """Host-side epilogue of a guarded execution: unpack ``(y, stats)``,
+    compare against the build-time tolerances (one scalar readback — the
+    documented cost of check/enforce), account violations, and enforce
+    the mode. Unguarded executions pass through untouched."""
+    state = getattr(plan, "_guard_state", {}).get((direction, dims))
+    if state is None:
+        return out
+    y, stats = out
+    vals = np.asarray(stats)
+    resid, drift = float(vals[0]), float(vals[1])
+    mode = plan._guard_mode
+    fp = fingerprint(plan, direction)
+    violations = []
+    # NaN residual (corruption reached the reduction itself) must fire:
+    # compare via "not <=", which is True for NaN.
+    if not resid <= state.tolerance:
+        violations.append(("parseval" if state.spec.check == "parseval"
+                           else "finite", resid, state.tolerance))
+        obs.metrics.inc("guard.parseval_violations")
+    if state.probe and drift >= 0 and not drift <= state.wire_budget:
+        violations.append(("wire_drift", drift, state.wire_budget))
+        obs.metrics.inc("guard.wire_drift_violations")
+    if not violations:
+        return y
+    for check, value, tol in violations:
+        obs.notice(
+            f"guard[{check}]: residual {value:.3e} exceeds tolerance "
+            f"{tol:.3e} ({mode}) on {fp['plan']} {fp['shape']} "
+            f"{fp['comm']}/{fp['send']}/opt{fp['opt']}/{fp['wire']} "
+            f"{direction}",
+            name="guard.violation", check=check, value=value,
+            tolerance=tol, mode=mode, **{k: v for k, v in fp.items()})
+    if mode == "enforce":
+        check, value, tol = violations[0]
+        raise GuardViolation(check, value, tol, fp)
+    # check mode: a compressed wire implicated in a violation falls back
+    # to native for subsequent calls (the issue's graceful-degradation
+    # contract); the current result is still returned as computed.
+    if plan.config.wire_dtype != "native":
+        from . import fallback
+        fallback.demote_wire(
+            plan, reason=f"{violations[0][0]} residual "
+                         f"{violations[0][1]:.3e} in check mode")
+    return y

@@ -1,0 +1,217 @@
+"""Deterministic fault injection — the chaos half of the resilience layer.
+
+A resilience layer that is never exercised is a liability: the guards
+(``guards.py``), fallback ladder (``fallback.py``) and the host-side
+retry/timeout machinery (wisdom lock breaking, coordinator backoff,
+autotune cell timeouts) all need a way to fail ON DEMAND, deterministically,
+in CI. This module is that switch: seed-keyed injectors activated ONLY by
+``$DFFT_FAULT_SPEC`` — with the variable unset every hook returns its input
+unchanged and adds ZERO ops to any traced program (compiled HLO is
+byte-identical to the pre-injection programs, pinned by
+``tests/test_resilience.py``).
+
+Fault-spec grammar (one fault per process)::
+
+    kind:mode[:param][@seed=N]
+
+    wire:nan                 # one payload element of every exchange -> NaN
+    wire:bitflip             # XOR the top exponent bit of one element
+    wire:scale[:F]           # scale the whole exchange payload by F (0.5)
+    coordinator:down[:K]     # coordinator connect fails (first K attempts;
+                             # no K = every attempt)
+    wisdom:stale-lock        # the wisdom advisory flock reads as held by a
+                             # hung process (exercises stale-break/timeout)
+    autotune:hang[:S]        # every autotune race cell sleeps S seconds
+                             # (3600 default) before measuring
+
+``seed`` (default 0) keys the corrupted element index, so a chaos run is
+reproducible bit-for-bit. The wire injectors corrupt the payload at the
+``wire_encode``/``wire_decode`` boundary in ``parallel/transpose.py`` —
+AFTER the encode, so what travels (and what the guards must catch) is the
+corrupted wire image, exactly like a real ICI/DCN fault. Injection sites
+count into ``obs.metrics`` (``inject.wire_faults`` at trace time) and emit
+``inject.*`` events so a chaos run's event log shows what was injected
+where.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+
+ENV_VAR = "DFFT_FAULT_SPEC"
+
+_WIRE_MODES = ("nan", "bitflip", "scale")
+_KINDS = {
+    "wire": _WIRE_MODES,
+    "coordinator": ("down",),
+    "wisdom": ("stale-lock",),
+    "autotune": ("hang",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One parsed ``$DFFT_FAULT_SPEC`` entry."""
+
+    kind: str
+    mode: str
+    param: Optional[float] = None
+    seed: int = 0
+
+    def __str__(self) -> str:  # round-trips through parse_fault_spec
+        s = f"{self.kind}:{self.mode}"
+        if self.param is not None:
+            s += f":{self.param:g}"
+        if self.seed:
+            s += f"@seed={self.seed}"
+        return s
+
+
+def parse_fault_spec(s: str) -> FaultSpec:
+    """Parse the grammar above; raises ``ValueError`` on a malformed spec.
+    Unlike every other resilience surface this FAILS LOUDLY: a chaos run
+    whose fault spec silently parsed as "no fault" would pass vacuously."""
+    text = str(s).strip()
+    seed = 0
+    if "@" in text:
+        text, _, tail = text.partition("@")
+        key, _, val = tail.partition("=")
+        if key.strip() != "seed":
+            raise ValueError(f"unknown fault-spec attribute {key!r} "
+                             f"(only @seed=N is defined)")
+        seed = int(val)
+    parts = [p.strip() for p in text.split(":")]
+    if len(parts) < 2 or len(parts) > 3 or not all(parts[:2]):
+        raise ValueError(
+            f"fault spec must be kind:mode[:param][@seed=N], got {s!r}")
+    kind, mode = parts[0].lower(), parts[1].lower()
+    if kind not in _KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} "
+                         f"(choose from {sorted(_KINDS)})")
+    if mode not in _KINDS[kind]:
+        raise ValueError(f"unknown {kind} fault mode {mode!r} "
+                         f"(choose from {_KINDS[kind]})")
+    param = float(parts[2]) if len(parts) == 3 else None
+    return FaultSpec(kind, mode, param, seed)
+
+
+def active() -> Optional[FaultSpec]:
+    """The process's fault spec, or None. Read from the environment on
+    every call (trace-time for the wire hooks), so a test can flip faults
+    on/off between plan builds without touching module state."""
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if not raw:
+        return None
+    return parse_fault_spec(raw)
+
+
+def _spec_of(kind: str) -> Optional[FaultSpec]:
+    spec = active()
+    return spec if spec is not None and spec.kind == kind else None
+
+
+# ---------------------------------------------------------------------------
+# wire payload corruption (traced; zero ops when inactive)
+# ---------------------------------------------------------------------------
+
+def _uint_dtype(itemsize: int):
+    import jax.numpy as jnp
+    return {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[itemsize]
+
+
+def _bitflip_float(x, idx: int):
+    """XOR the top exponent bit of flat element ``idx`` of a float array —
+    a genuine single-bit memory fault, turning an O(1) value into an
+    O(1e38) one (f32) so the energy guard sees it."""
+    import jax.numpy as jnp
+    from jax import lax
+    flat = x.ravel()
+    nbits = x.dtype.itemsize * 8
+    u = lax.bitcast_convert_type(flat, _uint_dtype(x.dtype.itemsize))
+    mask = np.asarray(1 << (nbits - 2), dtype=u.dtype)
+    u = u.at[idx].set(u[idx] ^ mask)
+    return lax.bitcast_convert_type(u, x.dtype).reshape(x.shape)
+
+
+def taint_wire(x, where: str):
+    """Corrupt an exchange payload per the active wire fault (identity —
+    same traced value, zero added ops — when no wire fault is active).
+    Called with the payload exactly as it travels: the planar bf16 planes
+    under a compressed wire, the native complex block otherwise."""
+    spec = _spec_of("wire")
+    if spec is None:
+        return x
+    import jax.numpy as jnp
+    obs.metrics.inc("inject.wire_faults")
+    obs.event("inject.wire_fault", mode=spec.mode, where=where,
+              seed=spec.seed, shape=list(x.shape), dtype=str(x.dtype))
+    size = int(np.prod(x.shape)) or 1
+    idx = spec.seed % size
+    if spec.mode == "scale":
+        # Python float: weak-typed, so the payload KEEPS its wire dtype
+        # (a strong f32 scalar would promote bf16 planes to f32 and the
+        # corrupted image would no longer travel as the compressed wire).
+        factor = 0.5 if spec.param is None else float(spec.param)
+        return x * factor
+    if spec.mode == "nan":
+        return x.ravel().at[idx].set(jnp.nan).reshape(x.shape)
+    # bitflip
+    if jnp.iscomplexobj(x):
+        re = _bitflip_float(jnp.real(x), idx)
+        from jax import lax
+        return lax.complex(re, jnp.imag(x))
+    return _bitflip_float(x, idx)
+
+
+# ---------------------------------------------------------------------------
+# host-side simulators (coordinator / lock / autotune)
+# ---------------------------------------------------------------------------
+
+class SimulatedFault(ConnectionError):
+    """Raised by the host-side simulators; carries the spec for logs."""
+
+
+def maybe_fail_coordinator(attempt: int) -> None:
+    """Simulate coordinator unavailability: raise on connect attempt
+    ``attempt`` (0-based) while it is below the spec's failure count
+    (``coordinator:down:K``; no K = fail every attempt)."""
+    spec = _spec_of("coordinator")
+    if spec is None:
+        return
+    fails = float("inf") if spec.param is None else int(spec.param)
+    if attempt < fails:
+        obs.metrics.inc("inject.coordinator_failures")
+        raise SimulatedFault(
+            f"injected coordinator unavailability (attempt {attempt + 1} "
+            f"of {fails if fails != float('inf') else 'unbounded'} failures)")
+
+
+def lock_contended() -> bool:
+    """Whether the wisdom advisory flock should read as held by a hung
+    process (``wisdom:stale-lock``) — drives ``utils/wisdom.py`` through
+    its stale-break and acquisition-timeout paths without needing a real
+    suspended holder in CI."""
+    if _spec_of("wisdom") is None:
+        return False
+    obs.metrics.inc("inject.lock_contentions")
+    return True
+
+
+def maybe_hang_cell(label: str) -> None:
+    """Simulate a hung autotune race cell (``autotune:hang[:S]``): sleep
+    inside the cell so the per-cell wall-clock timeout
+    (``testing/autotune.py``) must fire for the race to proceed."""
+    spec = _spec_of("autotune")
+    if spec is None:
+        return
+    delay = 3600.0 if spec.param is None else float(spec.param)
+    obs.metrics.inc("inject.cell_hangs")
+    obs.event("inject.cell_hang", label=label, seconds=delay)
+    time.sleep(delay)

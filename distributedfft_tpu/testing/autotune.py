@@ -25,6 +25,8 @@ reported as a degenerate measurement, not a timing.
 
 from __future__ import annotations
 
+import os
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -32,7 +34,65 @@ import numpy as np
 
 from .. import obs
 from ..params import FFTNorm
+from ..resilience import inject
 from . import chaintimer
+
+
+class CellTimeout(RuntimeError):
+    """A race cell exceeded its wall-clock budget (resilience leg 4)."""
+
+
+def _cell_timeout_s() -> Optional[float]:
+    """Per-cell wall-clock budget (``$DFFT_AUTOTUNE_CELL_TIMEOUT_S``,
+    default 600 s; 0/negative disables). Generous by default — it exists
+    to stop one WEDGED candidate (hung compile, deadlocked collective
+    attempt) from stalling the whole race forever, not to clip slow
+    ones."""
+    raw = os.environ.get("DFFT_AUTOTUNE_CELL_TIMEOUT_S", "").strip()
+    try:
+        v = float(raw) if raw else 600.0
+    except ValueError:
+        v = 600.0
+    return v if v > 0 else None
+
+
+def _call_with_timeout(fn, label: str):
+    """Run one race cell under the wall-clock budget: the cell runs in a
+    daemon thread and an expiry raises ``CellTimeout`` — the racer then
+    records the candidate as failed and the surviving candidates decide
+    the race (a hung candidate degrades, never wedges). The abandoned
+    thread keeps running detached (a truly hung computation cannot be
+    interrupted portably); daemon status keeps it from blocking process
+    exit. DISABLED in multi-controller runs: abandoning a collective on
+    one process while its peers wait would trade a local hang for a
+    distributed one — there the coordinator-level timeouts own the
+    problem."""
+    import jax
+    timeout = _cell_timeout_s()
+    if timeout is None or jax.process_count() > 1:
+        return fn()
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"autotune-cell:{label}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        obs.metrics.inc("autotune.cell_timeouts")
+        obs.notice(
+            f"autotune: cell {label} exceeded {timeout:.0f}s; abandoned "
+            "(surviving candidates decide the race)",
+            name="autotune.cell_timeout", label=label, timeout_s=timeout)
+        raise CellTimeout(f"race cell exceeded {timeout:.0f}s wall clock")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 @dataclass
@@ -154,11 +214,17 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
         try:
             with obs.span("autotune.race_cell", race="local_fft",
                           label=c.label):
-                c.per_iter_ms, c.rel_err, c.error = _measure(
-                    shape, c.backend, k, repeats, inner, x, x_absmax,
-                    settings=st)
+                def cell(c=c, st=st):
+                    # The injected hang runs INSIDE the timed cell, so a
+                    # simulated wedge exercises the timeout, not the race.
+                    inject.maybe_hang_cell(c.label)
+                    return _measure(shape, c.backend, k, repeats, inner,
+                                    x, x_absmax, settings=st)
+
+                c.per_iter_ms, c.rel_err, c.error = _call_with_timeout(
+                    cell, c.label)
             c.ok = (c.error is None and c.rel_err <= budget_rel_err)
-        except Exception as e:  # backend unavailable on this platform
+        except Exception as e:  # backend unavailable / cell timed out
             c.error = f"{type(e).__name__}: {e}"
         if verbose:
             print(f"  {c.label:16s} {c.per_iter_ms:8.3f} ms  "
@@ -254,6 +320,8 @@ def _measure_comm_candidates(cands, kind, global_size, partition, base,
 
     from . import testcases as tc
 
+    from ..resilience import fallback
+
     rdt = np.float64 if base.double_prec else np.float32
     xs = np.random.default_rng(seed).random(
         tuple(global_size.shape)).astype(rdt)
@@ -262,22 +330,35 @@ def _measure_comm_candidates(cands, kind, global_size, partition, base,
         obs.metrics.inc("autotune.race_cells")
         try:
             with obs.span("autotune.race_cell", race="comm", label=c.label):
+                # guards="off": the race must time the production program
+                # without the guard readback; fallback.suppressed(): a
+                # failing candidate must LOSE the race, not measure its
+                # own silent demotion.
                 cfg = dc.replace(base, comm_method=c.comm,
-                                 comm_method2=c.comm2, opt=c.opt)
+                                 comm_method2=c.comm2, opt=c.opt,
+                                 guards="off")
                 if c.send is not None:
                     cfg = dc.replace(cfg, send_method=c.send,
                                      send_method2=None,
                                      streams_chunks=c.chunks)
                 if c.wire is not None:
                     cfg = dc.replace(cfg, wire_dtype=c.wire)
-                plan = tc.make_plan(kind, global_size, partition, cfg,
-                                    sequence=sequence, mesh=mesh,
-                                    transform=transform)
-                x = plan.pad_input(xs)
-                fwd, inv = tc._fused_fns(plan, dims)
-                c.fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
-                spec = fwd(x)
-                c.inv_ms = _time_plan_ms(inv, spec, iterations, warmup)
+
+                def cell(cfg=cfg, label=c.label):
+                    inject.maybe_hang_cell(label)
+                    with fallback.suppressed():
+                        plan = tc.make_plan(kind, global_size, partition,
+                                            cfg, sequence=sequence,
+                                            mesh=mesh, transform=transform)
+                        x = plan.pad_input(xs)
+                        fwd, inv = tc._fused_fns(plan, dims)
+                        fwd_ms = _time_plan_ms(fwd, x, iterations, warmup)
+                        spec = fwd(x)
+                        inv_ms = _time_plan_ms(inv, spec, iterations,
+                                               warmup)
+                    return fwd_ms, spec, inv_ms
+
+                c.fwd_ms, spec, c.inv_ms = _call_with_timeout(cell, c.label)
                 compressed = c.wire not in (None, "native")
                 if not compressed and ref_spec is None:
                     ref_spec = spec

@@ -101,6 +101,16 @@ except ImportError:
 
     obs = _NullObs()
 
+try:
+    from ..resilience import inject as _inject
+except ImportError:
+    # Standalone load (see the obs fallback above): injection degrades to
+    # inactive, like every other wisdom failure mode.
+    class _inject:  # noqa: D401 — minimal stand-in
+        @staticmethod
+        def lock_contended():
+            return False
+
 WISDOM_VERSION = 3
 # Store versions that migrate on load instead of reading empty (their
 # non-"comm" slots carry over; see _migrate_legacy).
@@ -168,23 +178,114 @@ def store_for_config(config) -> Optional["WisdomStore"]:
                       getattr(config, "use_wisdom", True))
 
 
+def _lock_timeout_s() -> float:
+    try:
+        return float(os.environ.get("DFFT_WISDOM_LOCK_TIMEOUT_S", "10"))
+    except ValueError:
+        return 10.0
+
+
+def _lock_stale_s() -> float:
+    try:
+        return float(os.environ.get("DFFT_WISDOM_LOCK_STALE_S", "60"))
+    except ValueError:
+        return 60.0
+
+
 @contextlib.contextmanager
 def _advisory_lock(path: str):
     """Best-effort exclusive ``fcntl.flock`` on ``path + '.lock'``,
     serializing the read-merge-replace window across processes sharing one
-    store. Degrades to unlocked on platforms/filesystems without flock:
-    the write itself stays atomic (tmp + ``os.replace``), so concurrency
-    can then lose an update, never corrupt the file."""
+    store — with BOUNDED acquisition (resilience leg 4): the old blocking
+    ``LOCK_EX`` would wait FOREVER on a holder that hung mid-window
+    (suspended process, dead NFS client holding the lease), wedging every
+    later recorder. Now the lock is polled non-blocking up to
+    ``$DFFT_WISDOM_LOCK_TIMEOUT_S`` (default 10 s):
+
+    * a holder that DIED outright is harmless — the kernel releases its
+      flock with the fd, and the leftover ``.lock`` FILE is reused, never
+      treated as held (pinned by tests/test_resilience.py's kill-the-
+      holder regression);
+    * a holder still ALIVE but hung is detected by age: when the lock
+      file's mtime (touched on every acquisition) is older than
+      ``$DFFT_WISDOM_LOCK_STALE_S`` (default 60 s), the lock file is
+      BROKEN once — unlinked and re-created, so the hung holder keeps its
+      flock on the orphaned inode while new recorders serialize on the
+      fresh one (``wisdom.lock_breaks`` metric + notice);
+    * past the timeout the writer proceeds UNLOCKED
+      (``wisdom.lock_timeouts``): the write itself stays atomic (tmp +
+      ``os.replace``), so a concurrent update can be lost — wisdom loses
+      measurements, never correctness, and never hangs.
+
+    Degrades to unlocked on platforms/filesystems without flock, exactly
+    as before. ``$DFFT_FAULT_SPEC=wisdom:stale-lock`` simulates the hung
+    holder (``resilience/inject.py``) so CI exercises these paths."""
+    lock_path = path + ".lock"
     lock = None
     try:
         try:
             import fcntl
-            lock = open(path + ".lock", "a")
-            fcntl.flock(lock, fcntl.LOCK_EX)
-        except (ImportError, OSError):
-            if lock is not None:
-                lock.close()
-            lock = None
+        except ImportError:
+            fcntl = None
+        if fcntl is not None:
+            deadline = time.monotonic() + _lock_timeout_s()
+            delay, broke = 0.005, False
+            while True:
+                try:
+                    lock = open(lock_path, "a")
+                    if _inject.lock_contended():
+                        raise BlockingIOError("injected: lock held by a "
+                                              "hung holder")
+                    fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    try:
+                        os.utime(lock_path)  # acquisition stamp (age base)
+                    except OSError:
+                        pass
+                    break  # acquired
+                except BlockingIOError:
+                    # Genuinely held by another process: stale-break once,
+                    # else poll until the acquisition deadline.
+                    if lock is not None:
+                        lock.close()
+                        lock = None
+                    try:
+                        age = time.time() - os.path.getmtime(lock_path)
+                    except OSError:
+                        age = 0.0
+                    if not broke and age > _lock_stale_s():
+                        broke = True
+                        try:
+                            os.unlink(lock_path)
+                        except OSError:
+                            pass
+                        obs.metrics.inc("wisdom.lock_breaks")
+                        obs.notice(
+                            f"wisdom: broke stale lock {lock_path} "
+                            f"(age {age:.0f}s > {_lock_stale_s():.0f}s)",
+                            name="wisdom.lock_break", path=lock_path,
+                            age_s=round(age, 1))
+                        continue
+                    if time.monotonic() >= deadline:
+                        obs.metrics.inc("wisdom.lock_timeouts")
+                        obs.notice(
+                            f"wisdom: lock {lock_path} not acquired within "
+                            f"{_lock_timeout_s():.0f}s; writing unlocked "
+                            "(atomic replace; a concurrent update may be "
+                            "lost, never corrupted)",
+                            name="wisdom.lock_timeout", path=lock_path)
+                        break  # proceed unlocked
+                    time.sleep(delay)
+                    delay = min(0.1, delay * 2)
+                except OSError:
+                    # Not contention: flock unsupported on this filesystem
+                    # (ENOTSUP) or the lock path unwritable. Degrade to
+                    # unlocked IMMEDIATELY, exactly like the pre-timeout
+                    # code — polling would stall every write for the full
+                    # timeout on a platform that can never acquire.
+                    if lock is not None:
+                        lock.close()
+                        lock = None
+                    break
         yield
     finally:
         if lock is not None:
@@ -464,6 +565,34 @@ def wire_record(candidate, budget: Optional[float] = None) -> Dict[str, Any]:
     return rec
 
 
+def stamp_demotion(store: "WisdomStore", key: str, slot: str, rung: str,
+                   reason: str) -> bool:
+    """Mark the recorded winner under ``entries[key][slot]`` as DEMOTED
+    (resilience fallback: the cell failed at run time — lowering, compile
+    or a guard violation). Stamped records read as misses
+    (``_comm_hit_fold``/``_wire_hit_fold``), so the store stops
+    recommending the failing cell until a fresh race re-records it (a new
+    ``record()`` of the slot replaces the stamped dict wholesale,
+    clearing the stamp). A slot with no record gets a bare stamp — it
+    already reads as a miss, but the stamp preserves WHY for
+    ``dfft-explain``. Best-effort like every wisdom write."""
+    rec = store.lookup(key, slot) or {}
+    rec.update({
+        "demoted": True,
+        "demoted_rung": rung,
+        "demoted_reason": str(reason)[:300],
+        "demoted_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    })
+    ok = store.record(key, slot, rec)
+    if ok:
+        obs.metrics.inc("wisdom.demotion_stamps")
+        obs.notice(
+            f"wisdom[{slot}]: demotion stamp (rung {rung}) -> {store.path}",
+            name="wisdom.demotion", slot=slot, rung=rung,
+            store=store.path)
+    return ok
+
+
 def _valid_local_rec(rec: Dict[str, Any]) -> bool:
     from ..ops.fft import BACKENDS
     if rec.get("fft_backend") not in BACKENDS:
@@ -566,6 +695,11 @@ def _comm_hit_fold(norm_base, rec, race_wire: bool, budget: float):
     would do."""
     if rec is None:
         return None, "no record"
+    if rec.get("demoted"):
+        # Resilience fallback stamped this cell after a runtime failure
+        # (lowering/compile/guard): the store must stop recommending it.
+        # A miss re-races and re-records, clearing the stamp.
+        return None, "record demoted after a runtime failure"
     try:
         folded = _fold_comm_rec(norm_base, rec)
     except (KeyError, TypeError, ValueError):
@@ -596,6 +730,8 @@ def _wire_hit_fold(base, rec, budget: float):
     ``peek_config``)."""
     if rec is None:
         return None, "no record"
+    if rec.get("demoted"):
+        return None, "record demoted after a runtime failure"
     try:
         folded = _fold_wire_rec(base, rec)
     except (KeyError, TypeError, ValueError):

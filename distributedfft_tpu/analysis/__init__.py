@@ -1,0 +1,44 @@
+"""Static analysis: the plan/HLO contract verifier and repo-invariant
+lints (``dfft-verify``).
+
+The reference validates its comm x send matrix only dynamically — one
+test executable per configuration (SURVEY L4/L5). This package is the
+static complement: every rendering x direction x wire x guard combo is
+LOWERED AND COMPILED (never executed) and checked against declarative
+contracts, so the invariants that keep the three plan families honest
+live in one registry instead of N drifting test asserts:
+
+* ``hloscan``    — compile/lower plan programs, collective census,
+  metadata-stripped op-graph fingerprints, exchange payload extraction;
+* ``contracts``  — the declarative contract model + registry: expected
+  collective census per rendering, forbidden-op rules, predicted-vs-
+  actual exchange payload bytes reconciled with ``wire_nbytes``;
+* ``jaxprlint``  — jaxpr dataflow lints (unpaired wire encode/decode,
+  dtype drift across an exchange, guard ops present at ``guards="off"``);
+* ``srclint``    — AST-level repo-invariant lints (no host I/O in traced
+  fns, host-only modules stay jax.numpy-free, wisdom-store writes only
+  under the flock helper);
+* ``verify``     — the ``dfft-verify`` runner: the full combo matrix as
+  a pass/fail table, mutation self-tests, JSON artifact for CI.
+
+These are the "HLO byte-identity pins as the migration safety net" the
+Plan-IR refactor (ROADMAP item 1) gates on: a rendering PR is done when
+``dfft-verify`` passes clean.
+"""
+
+from . import contracts, hloscan, jaxprlint, srclint  # noqa: F401
+from .contracts import (  # noqa: F401
+    Contract,
+    ContractViolation,
+    check_contract,
+    contract_for,
+    verify_plan,
+)
+from .hloscan import (  # noqa: F401
+    collective_census,
+    compiled_text,
+    contains_bf16,
+    lower_plan,
+    op_graph_fingerprint,
+    plan_fingerprint,
+)

@@ -1,0 +1,213 @@
+"""Jaxpr dataflow lints: invariants of the traced program that neither
+numerics nor the compiled-HLO census can see.
+
+The wire layer's contract is *structural*: every ``wire_encode`` (a
+convert to bf16) is matched by a ``wire_decode`` (a convert from bf16)
+on the far side of the exchange, restoring the payload's pre-encode
+float width. The HLO census counts collectives but the CPU backend is
+free to hoist/sink converts, so pairing is checked on the JAXPR — the
+program as traced, before any backend rewrites:
+
+* **unpaired encode/decode** — a bf16 wire crossing whose decode was
+  dropped leaves the payload bf16 downstream (silent precision loss the
+  first time a non-convert op consumes it);
+* **bf16 leak** — a traced output carrying bf16 is the terminal form of
+  the same bug;
+* **dtype drift across an exchange** — encodes and decodes must restore
+  the SAME float widths (a c128 plan whose decode lands on f32 silently
+  halves precision past the wire);
+* **guard ops at guards="off"** — an off-mode build returns exactly the
+  transform result; the guarded wrapper's ``(y, stats)`` pair showing up
+  means guard ops leaked into the default path (the dynamic half of the
+  zero-overhead-off pin).
+
+All checks accept a plan (``lint_plan``) or a bare jaxpr (the harness
+the mutation tests feed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    """One jaxpr-lint diagnostic; ``lint`` names the violated invariant
+    (the mutation tests assert on it)."""
+
+    lint: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"[jaxprlint/{self.lint}] {self.message}"
+
+
+def _subjaxprs(params: dict) -> Iterator[Any]:
+    """Nested jaxprs inside an eqn's params, across jax versions (pjit
+    carries ``jaxpr``, control flow ``branches``/``body_jaxpr``/... —
+    scan every param value duck-typed on ``.eqns``)."""
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            inner = getattr(x, "jaxpr", x)
+            if hasattr(inner, "eqns"):
+                yield inner
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Every eqn of a (closed) jaxpr, recursing through pjit / shard_map /
+    control-flow sub-jaxprs."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _is_bf16(dtype: Any) -> bool:
+    return "bfloat16" in str(dtype)
+
+
+def _convert_ends(eqn: Any) -> Optional[tuple]:
+    """``(src_dtype, dst_dtype)`` of a convert eqn, else None."""
+    if eqn.primitive.name != "convert_element_type":
+        return None
+    return (eqn.invars[0].aval.dtype, eqn.outvars[0].aval.dtype)
+
+
+# The named-axis exchange primitives a plan stages (psum et al. are
+# reductions, not payload moves).
+EXCHANGE_PRIMITIVES = ("all_to_all", "ppermute")
+
+
+def lint_wire_pairing(jaxpr: Any, expect_crossings: int = 0
+                      ) -> List[LintFinding]:
+    """Pairing/drift/leak checks over every convert in the jaxpr.
+    ``expect_crossings`` is the number of wire crossings the plan's
+    exchange declaration predicts for a compressed wire (0 = the wire is
+    native and NO bf16 conversion may appear at all)."""
+    encodes: List[Any] = []  # src dtypes of converts INTO bf16
+    decodes: List[Any] = []  # dst dtypes of converts OUT OF bf16
+    for eqn in iter_eqns(jaxpr):
+        ends = _convert_ends(eqn)
+        if ends is None:
+            continue
+        src, dst = ends
+        if _is_bf16(dst) and not _is_bf16(src):
+            encodes.append(src)
+        elif _is_bf16(src) and not _is_bf16(dst):
+            decodes.append(dst)
+    out: List[LintFinding] = []
+    if expect_crossings == 0:
+        if encodes or decodes:
+            out.append(LintFinding(
+                "wire-pairing",
+                f"0 wire crossings expected but {len(encodes)} bf16 "
+                f"encode(s) / {len(decodes)} decode(s) traced; the wire "
+                "layer must be structurally inert here"))
+        return out
+    if len(encodes) != len(decodes):
+        out.append(LintFinding(
+            "wire-pairing",
+            f"unpaired wire_encode/wire_decode: {len(encodes)} convert(s) "
+            f"to bf16 but {len(decodes)} back — a dropped decode leaves "
+            "the payload bf16 past the exchange"))
+    if len(encodes) < expect_crossings:
+        out.append(LintFinding(
+            "wire-pairing",
+            f"compressed wire declares {expect_crossings} crossing(s) but "
+            f"only {len(encodes)} encode(s) traced — the exchange payload "
+            "is travelling unencoded"))
+    # Drift only means something for PAIRED conversions: unequal counts
+    # already reported above, and would trivially re-trip this rule.
+    if len(encodes) == len(decodes) and \
+            sorted(map(str, encodes)) != sorted(map(str, decodes)):
+        out.append(LintFinding(
+            "wire-drift",
+            f"dtype drift across the exchange: encoded from "
+            f"{sorted(map(str, encodes))} but decoded to "
+            f"{sorted(map(str, decodes))} — the wire must restore the "
+            "pre-encode float width"))
+    closed = jaxpr if hasattr(jaxpr, "out_avals") else None
+    if closed is not None:
+        leaks = [a for a in closed.out_avals if _is_bf16(a.dtype)]
+        if leaks:
+            out.append(LintFinding(
+                "wire-pairing",
+                f"{len(leaks)} traced output(s) still bf16 — a wire "
+                "payload leaked out undecoded"))
+    return out
+
+
+def lint_exchange_dtypes(jaxpr: Any) -> List[LintFinding]:
+    """Every exchange primitive must move its payload dtype unchanged
+    (a collective that retypes is a tracing bug, and under a compressed
+    wire both ends must be the ENCODED dtype)."""
+    out: List[LintFinding] = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in EXCHANGE_PRIMITIVES:
+            continue
+        din = {str(v.aval.dtype) for v in eqn.invars
+               if hasattr(v, "aval") and hasattr(v.aval, "dtype")}
+        dout = {str(v.aval.dtype) for v in eqn.outvars
+                if hasattr(v.aval, "dtype")}
+        if din != dout:
+            out.append(LintFinding(
+                "exchange-dtype",
+                f"{eqn.primitive.name} retypes its payload: {sorted(din)} "
+                f"-> {sorted(dout)}"))
+    return out
+
+
+def lint_guard_arity(jaxpr: Any, guard_mode: str) -> List[LintFinding]:
+    """The guarded wrapper returns ``(y, stats)``; an off-mode build
+    returning more than the transform result means guard ops leaked into
+    the default path."""
+    closed = jaxpr if hasattr(jaxpr, "out_avals") else None
+    if closed is None:
+        return []
+    n = len(closed.out_avals)
+    if guard_mode == "off" and n != 1:
+        return [LintFinding(
+            "guard-off",
+            f"guards=\"off\" build returns {n} outputs (expected the "
+            "transform result alone) — guard ops present in the default "
+            "path")]
+    if guard_mode != "off" and n != 2:
+        return [LintFinding(
+            "guard-arity",
+            f"guards=\"{guard_mode}\" build returns {n} outputs (expected "
+            "the (result, stats) pair)")]
+    return []
+
+
+def plan_jaxpr(plan: Any, direction: str = "forward", dims: int = 3) -> Any:
+    """The traced (closed) jaxpr of one direction's builder — guards and
+    wire layer included, exactly what the exec path jits."""
+    import jax
+
+    from . import hloscan
+
+    fn = hloscan._builder(plan, direction, dims)
+    return jax.make_jaxpr(fn)(hloscan._input_aval(plan, direction, dims))
+
+
+def lint_plan(plan: Any, direction: str = "forward",
+              dims: int = 3) -> List[LintFinding]:
+    """All jaxpr lints over one direction of a live plan."""
+    from . import contracts
+
+    jaxpr = plan_jaxpr(plan, direction, dims)
+    wire = plan.config.wire_dtype
+    crossings = 0
+    if wire != "native":
+        decls = contracts._FAMILIES[contracts.family_of(plan)](
+            plan, direction, dims)
+        crossings = len(decls)
+        if getattr(plan, "_guard_mode", "off") != "off":
+            crossings += 1  # the guard drift probe's extra encode/decode
+    out = lint_wire_pairing(jaxpr, expect_crossings=crossings)
+    out += lint_exchange_dtypes(jaxpr)
+    out += lint_guard_arity(jaxpr, getattr(plan, "_guard_mode", "off"))
+    return out

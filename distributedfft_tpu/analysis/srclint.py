@@ -1,0 +1,384 @@
+"""AST-level repo-invariant lints: properties of the SOURCE that no
+runtime test can pin without racing the exact failure.
+
+Three rules, each a real invariant this codebase already relies on:
+
+* ``traced-host-io`` — functions that get traced (passed to ``jax.jit``
+  / ``shard_map`` / ``lax.fori_loop`` / ``lax.scan`` / ``lax.map`` /
+  ``grad`` / ``vmap``, or called by one in the same module) must not
+  read ``os.environ`` or do host I/O (``open``, ``input``,
+  ``subprocess``): a traced env read executes once at trace time and
+  silently freezes into the compiled program — the exact bug class
+  ``Config.resolved_guards`` documents ("resolved once at plan
+  construction, so a mid-run env change cannot split a plan's
+  directions").
+* ``host-only-jnp`` — host-only modules (``utils/wisdom.py``,
+  ``obs/tracing.py``) must not import ``jax.numpy``: wisdom is loaded
+  standalone by the flock-contract subprocess tests and tracing must
+  stay importable before any backend exists; a ``jnp`` import would
+  initialize a backend as a side effect of reading a JSON file.
+* ``wisdom-flock`` — every wisdom-store write (the atomic
+  ``os.replace`` onto the store path) must be reachable only under the
+  ``_advisory_lock`` flock helper: a write outside the lock re-opens
+  the read-merge-replace race the helper exists to close. This is a
+  static race detector for the store.
+
+An inline ``# srclint: allow(<rule>)`` comment on the offending line
+suppresses a finding — visible, greppable, reviewed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+# Call names whose function-valued arguments become traced code.
+TRACING_ENTRY_POINTS = frozenset({
+    "jit", "shard_map", "fori_loop", "scan", "map", "while_loop", "cond",
+    "grad", "value_and_grad", "vmap", "pmap", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "make_jaxpr",
+})
+
+# Host-only modules (repo-relative): importing jax.numpy here couples a
+# pure-host code path to backend initialization.
+HOST_ONLY_MODULES = (
+    os.path.join("utils", "wisdom.py"),
+    os.path.join("obs", "tracing.py"),
+)
+
+_ALLOW_MARK = "# srclint: allow("
+
+
+@dataclasses.dataclass(frozen=True)
+class SrcFinding:
+    """One source-lint diagnostic (``rule`` is the invariant name the
+    mutation tests assert on)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"[srclint/{self.rule}] {self.path}:{self.line}: " \
+               f"{self.message}"
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression (``os.environ.get`` ->
+    "os.environ.get")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _allowed(src_lines: List[str], line: int, rule: str) -> bool:
+    if 1 <= line <= len(src_lines):
+        txt = src_lines[line - 1]
+        if _ALLOW_MARK + rule + ")" in txt:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# traced-host-io
+# ---------------------------------------------------------------------------
+
+class _FnIndex(ast.NodeVisitor):
+    """Function defs by name per lexical scope + the call edges and
+    traced roots of one module."""
+
+    def __init__(self) -> None:
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        self.traced_lambdas: List[ast.Lambda] = []
+        self._stack: List[ast.FunctionDef] = []
+        # (caller def or None, callee simple name) edges
+        self.calls: List[Tuple[Optional[ast.FunctionDef], str]] = []
+
+    def _visit_fn(self, node: Any) -> None:
+        self.defs.setdefault(node.name, []).append(node)
+        # Decorator roots: @jax.jit / @jit / @partial(jax.jit, ...) — any
+        # tracing entry point named anywhere in the decorator expression
+        # makes the decorated def traced (the most common JAX idiom).
+        for dec in node.decorator_list:
+            names = {sub.attr for sub in ast.walk(dec)
+                     if isinstance(sub, ast.Attribute)}
+            names |= {sub.id for sub in ast.walk(dec)
+                      if isinstance(sub, ast.Name)}
+            if names & TRACING_ENTRY_POINTS:
+                self.calls.append((None, "__root__:" + node.name))
+                break
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        caller = self._stack[-1] if self._stack else None
+        if name in TRACING_ENTRY_POINTS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self.calls.append((caller, "__root__:" + arg.id))
+                elif isinstance(arg, ast.Attribute):
+                    # jax.jit(self._body): resolve by the attribute's
+                    # terminal name against same-module defs.
+                    self.calls.append((caller, "__root__:" + arg.attr))
+                elif isinstance(arg, ast.Lambda):
+                    # Resolved after the walk, when self.defs is complete.
+                    self.traced_lambdas.append(arg)
+        else:
+            self.calls.append((caller, name))
+        self.generic_visit(node)
+
+
+_HOST_IO_CALLS = frozenset({"open", "input"})
+_HOST_IO_PREFIXES = ("subprocess.", "os.system", "os.popen", "os.getenv",
+                     "os.putenv", "os.environ")
+
+
+def _traced_fns(tree: ast.Module) -> Set[ast.FunctionDef]:
+    """The module's traced-function set: defs passed to a tracing entry
+    point, closed over same-module calls (a traced fn's callees are
+    traced too)."""
+    idx = _FnIndex()
+    idx.visit(tree)
+    traced: Set[ast.FunctionDef] = set()
+    # Traced lambdas: the functions they call (by simple name) are traced
+    # — resolved here, after the walk, so later defs resolve too.
+    for lam in idx.traced_lambdas:
+        for sub in ast.walk(lam):
+            if isinstance(sub, ast.Call):
+                for d in idx.defs.get(_call_name(sub), []):
+                    traced.add(d)
+    for caller, callee in idx.calls:
+        if callee.startswith("__root__:"):
+            for d in idx.defs.get(callee[len("__root__:"):], []):
+                traced.add(d)
+    # Propagate: callees of traced fns (by simple name, same module).
+    changed = True
+    while changed:
+        changed = False
+        for caller, callee in idx.calls:
+            if caller in traced and not callee.startswith("__root__:"):
+                for d in idx.defs.get(callee, []):
+                    if d not in traced:
+                        traced.add(d)
+                        changed = True
+            # A def nested inside a traced def is traced when called
+            # anywhere (the builder-closure idiom: the outer fn returns
+            # the traced body).
+        return_closures = set()
+        for fn in traced:
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.FunctionDef) and sub not in traced:
+                    return_closures.add(sub)
+        if return_closures:
+            traced |= return_closures
+            changed = True
+    return traced
+
+
+def _lint_traced_host_io(path: str, tree: ast.Module,
+                         src_lines: List[str]) -> List[SrcFinding]:
+    out: List[SrcFinding] = []
+    for fn in _traced_fns(tree):
+        for node in ast.walk(fn):
+            msg = None
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                dotted = _dotted(node.func)
+                if name in _HOST_IO_CALLS:
+                    msg = f"host I/O call {name}() inside traced " \
+                          f"function {fn.name!r}"
+                elif any(dotted.startswith(p) for p in _HOST_IO_PREFIXES):
+                    msg = f"{dotted}() inside traced function {fn.name!r}"
+            elif isinstance(node, (ast.Attribute, ast.Subscript)):
+                dotted = _dotted(node if isinstance(node, ast.Attribute)
+                                 else node.value)
+                if dotted.startswith("os.environ"):
+                    msg = f"os.environ read inside traced function " \
+                          f"{fn.name!r} (freezes into the compiled " \
+                          "program at trace time)"
+            if msg and not _allowed(src_lines, node.lineno,
+                                    "traced-host-io"):
+                out.append(SrcFinding("traced-host-io", path, node.lineno,
+                                      msg))
+    # De-duplicate per line (the Attribute inside a flagged Call would
+    # otherwise report the same read twice).
+    seen: Set[int] = set()
+    uniq = []
+    for f in sorted(out, key=lambda f: f.line):
+        if f.line not in seen:
+            seen.add(f.line)
+            uniq.append(f)
+    return uniq
+
+
+# ---------------------------------------------------------------------------
+# host-only-jnp
+# ---------------------------------------------------------------------------
+
+def _lint_host_only_jnp(path: str, tree: ast.Module,
+                        src_lines: List[str]) -> List[SrcFinding]:
+    if not any(path.endswith(suffix) for suffix in HOST_ONLY_MODULES):
+        return []
+    out: List[SrcFinding] = []
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("jax.numpy"):
+                    bad = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.numpy"):
+                bad = mod
+            elif mod == "jax" and any(a.name == "numpy"
+                                      for a in node.names):
+                bad = "jax.numpy"
+        if bad and not _allowed(src_lines, node.lineno, "host-only-jnp"):
+            out.append(SrcFinding(
+                "host-only-jnp", path, node.lineno,
+                f"host-only module imports {bad} (couples a pure-host "
+                "path to backend initialization)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wisdom-flock
+# ---------------------------------------------------------------------------
+
+LOCK_HELPER = "_advisory_lock"
+
+
+def _locked_withs(tree: ast.Module) -> List[ast.With]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Call) and \
+                        _call_name(ctx) == LOCK_HELPER:
+                    out.append(node)
+    return out
+
+
+def _lint_wisdom_flock(path: str, tree: ast.Module,
+                       src_lines: List[str]) -> List[SrcFinding]:
+    """Every ``os.replace`` (the store's atomic write) must sit inside a
+    ``with _advisory_lock(...)`` block — lexically, or in a function
+    whose every same-module call site does."""
+    if not path.endswith(os.path.join("utils", "wisdom.py")):
+        return []
+    locked = _locked_withs(tree)
+    locked_nodes: Set[ast.AST] = set()
+    for w in locked:
+        locked_nodes.update(ast.walk(w))
+
+    # Map replace calls to their enclosing function defs.
+    fns: Dict[str, ast.FunctionDef] = {}
+    parents: Dict[ast.AST, Optional[ast.FunctionDef]] = {}
+
+    def index(node: ast.AST, fn: Optional[ast.FunctionDef]) -> None:
+        for child in ast.iter_child_nodes(node):
+            here = child if isinstance(child, ast.FunctionDef) else fn
+            if isinstance(child, ast.FunctionDef):
+                fns[child.name] = child
+            parents[child] = fn
+            index(child, here)
+
+    index(tree, None)
+
+    def enclosing_fn(node: ast.AST) -> Optional[ast.FunctionDef]:
+        return parents.get(node)
+
+    replaces = [n for n in ast.walk(tree)
+                if isinstance(n, ast.Call)
+                and _dotted(n.func) == "os.replace"]
+    out: List[SrcFinding] = []
+    for call in replaces:
+        if call in locked_nodes:
+            continue
+        fn = enclosing_fn(call)
+        if fn is not None:
+            # One indirection level: the writer helper is fine when every
+            # same-module call of it happens under the lock.
+            sites = [c for c in ast.walk(tree)
+                     if isinstance(c, ast.Call)
+                     and _call_name(c) in (fn.name,)
+                     and c is not call]
+            if sites and all(s in locked_nodes for s in sites):
+                continue
+        if _allowed(src_lines, call.lineno, "wisdom-flock"):
+            continue
+        out.append(SrcFinding(
+            "wisdom-flock", path, call.lineno,
+            "wisdom-store write (os.replace) reachable outside the "
+            f"{LOCK_HELPER} flock helper — re-opens the "
+            "read-merge-replace race"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(src: str, path: str = "<string>") -> List[SrcFinding]:
+    """All source lints over one module's text (the harness the mutation
+    tests feed synthetic sources through)."""
+    tree = ast.parse(src, filename=path)
+    lines = src.splitlines()
+    out = _lint_traced_host_io(path, tree, lines)
+    out += _lint_host_only_jnp(path, tree, lines)
+    out += _lint_wisdom_flock(path, tree, lines)
+    return out
+
+
+def lint_file(path: str) -> List[SrcFinding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_repo(root: Optional[str] = None,
+              skip: Iterable[str] = ()) -> List[SrcFinding]:
+    """Lint every module under ``distributedfft_tpu/`` (or ``root``)."""
+    root = root or package_root()
+    out: List[SrcFinding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            if rel in skip:
+                continue
+            try:
+                out.extend(lint_file(path))
+            except SyntaxError as e:
+                out.append(SrcFinding("parse", path, e.lineno or 0,
+                                      f"syntax error: {e.msg}"))
+    return out

@@ -1,0 +1,464 @@
+"""``dfft-verify`` — the static plan/HLO contract verifier.
+
+Lowers and COMPILES (never executes) every rendering x direction x wire
+x guard combo of the three plan families and checks each against its
+declarative contract (``analysis/contracts.py``), plus:
+
+* jaxpr dataflow lints per combo (``analysis/jaxprlint.py``);
+* zero-overhead-off fingerprint pins: obs enabled/disabled, fault spec
+  set-then-unset, and ``guards="enforce"`` vs ``"check"`` compile to
+  byte-identical (metadata-stripped) op graphs;
+* AST repo-invariant lints (``analysis/srclint.py``) over the package
+  source.
+
+Prints a pass/fail table; ``--json`` writes the same as an artifact
+(the CI ``verify`` job uploads it). Exit code 0 = everything verified.
+
+Mutation self-test (the verifier verifying itself)::
+
+    dfft-verify --mutate drop-decode     # breaks a contract on purpose;
+    dfft-verify --mutate all             # all mutations, rc 0 iff every
+                                         # one is CAUGHT with the right
+                                         # diagnostic
+
+Examples::
+
+    dfft-verify --emulate-devices 8 --quick
+    dfft-verify --emulate-devices 8 --families slab --wires bf16
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+MUTATIONS = ("drop-decode", "bogus-census", "flip-forbidden")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="dfft-verify", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--families", default="slab,pencil,batched",
+                    help="comma list of plan families to verify")
+    ap.add_argument("--renderings", default="a2a,opt1,p2p,streams,ring",
+                    help="comma list of exchange renderings")
+    ap.add_argument("--wires", default="native,bf16",
+                    help="comma list of wire dtypes")
+    ap.add_argument("--guards", default="off,check",
+                    help="comma list of guard modes (enforce compiles "
+                         "identically to check — pinned by the enforce pin "
+                         "instead of brute-forced)")
+    ap.add_argument("--directions", default="forward,inverse")
+    ap.add_argument("--sequences", default="ZY_Then_X",
+                    help="comma list of slab sequences to sweep (default "
+                         "ZY_Then_X; pass all three to cube the slab axis)")
+    ap.add_argument("--quick", action="store_true",
+                    help="native wire + guards off + forward only")
+    ap.add_argument("--no-pins", action="store_true",
+                    help="skip the zero-overhead-off fingerprint pins")
+    ap.add_argument("--no-srclint", action="store_true",
+                    help="skip the AST repo-invariant lints")
+    ap.add_argument("--no-jaxprlint", action="store_true",
+                    help="skip the per-combo jaxpr dataflow lints")
+    ap.add_argument("--mutate", default=None,
+                    choices=MUTATIONS + ("all",),
+                    help="break a contract on purpose (verifier self-test)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full report as JSON")
+    ap.add_argument("--emulate-devices", type=int, default=0,
+                    help="force N virtual CPU devices (0 = real backend)")
+    ap.add_argument("--obs", action="store_true",
+                    help="print the obs metrics snapshot (hlo.* census "
+                         "gauges) after the table")
+    return ap
+
+
+def _csv(s: str) -> List[str]:
+    return [x.strip() for x in str(s).split(",") if x.strip()]
+
+
+# ---------------------------------------------------------------------------
+# the combo matrix
+# ---------------------------------------------------------------------------
+
+def _config(rendering: str, wire: str, guards: str) -> Any:
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import params as pm
+
+    kw: Dict[str, Any] = {}
+    if rendering == "a2a":
+        kw.update(comm_method=pm.CommMethod.ALL2ALL)
+    elif rendering == "opt1":
+        kw.update(comm_method=pm.CommMethod.ALL2ALL, opt=1)
+    elif rendering == "p2p":
+        kw.update(comm_method=pm.CommMethod.PEER2PEER)
+    elif rendering == "streams":
+        kw.update(comm_method=pm.CommMethod.ALL2ALL,
+                  send_method=pm.SendMethod.STREAMS, streams_chunks=3)
+    elif rendering == "ring":
+        kw.update(send_method=pm.SendMethod.RING)
+    else:
+        raise ValueError(f"unknown rendering {rendering!r}")
+    return dfft.Config(wire_dtype=wire, guards=guards, use_wisdom=False,
+                       **kw)
+
+
+def _make_plan(family: str, rendering: str, wire: str, guards: str,
+               sequence: str, ndev: int) -> Any:
+    """One combo's plan on the uneven-extent gate shape (padding on every
+    decomposed axis stays covered). Returns (plan, dims)."""
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import params as pm
+
+    cfg = _config(rendering, wire, guards)
+    if family == "slab":
+        return dfft.SlabFFTPlan(dfft.GlobalSize(20, 16, 16),
+                                pm.SlabPartition(ndev), cfg,
+                                sequence=sequence), 3
+    if family == "pencil":
+        p1 = 2 if ndev % 2 == 0 else 1
+        return dfft.PencilFFTPlan(dfft.GlobalSize(20, 16, 16),
+                                  pm.PencilPartition(p1, ndev // p1),
+                                  cfg), 3
+    if family == "batched":
+        return dfft.Batched2DFFTPlan(ndev, 20, 16, pm.SlabPartition(ndev),
+                                     cfg, shard="x"), 2
+    raise ValueError(f"unknown family {family!r}")
+
+
+def iter_combos(args: Any, ndev: int) -> Iterator[Dict[str, Any]]:
+    families = _csv(args.families)
+    renderings = _csv(args.renderings)
+    wires = ["native"] if args.quick else _csv(args.wires)
+    guards = ["off"] if args.quick else _csv(args.guards)
+    directions = ["forward"] if args.quick else _csv(args.directions)
+    sequences = _csv(args.sequences)
+    for family in families:
+        seqs = sequences if family == "slab" else [""]
+        for rendering in renderings:
+            for seq in seqs:
+                for wire in wires:
+                    for gm in guards:
+                        for d in directions:
+                            yield dict(family=family, rendering=rendering,
+                                       sequence=seq, wire=wire, guards=gm,
+                                       direction=d)
+    # The no-exchange contracts: single-device reference path and the
+    # embarrassingly-parallel batch sharding (one combo each — their
+    # contract is "zero collectives", rendering-independent).
+    if "slab" in families:
+        yield dict(family="slab", rendering="none", sequence="ZY_Then_X",
+                   wire="native", guards="off", direction="forward",
+                   single=True)
+    if "batched" in families:
+        yield dict(family="batched", rendering="none", sequence="",
+                   wire="native", guards="off", direction="forward",
+                   batch_shard=True)
+
+
+def run_combo(combo: Dict[str, Any], ndev: int,
+              no_jaxprlint: bool = False) -> Dict[str, Any]:
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import params as pm
+
+    from . import contracts, hloscan, jaxprlint
+
+    if combo.get("single"):
+        plan, dims = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
+                                      pm.SlabPartition(1),
+                                      dfft.Config(use_wisdom=False)), 3
+    elif combo.get("batch_shard"):
+        plan, dims = dfft.Batched2DFFTPlan(
+            ndev, 20, 16, pm.SlabPartition(ndev),
+            dfft.Config(use_wisdom=False), shard="batch"), 2
+    else:
+        plan, dims = _make_plan(combo["family"], combo["rendering"],
+                                combo["wire"], combo["guards"],
+                                combo["sequence"] or "ZY_Then_X", ndev)
+    direction = combo["direction"]
+    contract = contracts.contract_for(plan, direction, dims)
+    # One compile per combo: census and contract check share the module
+    # (verify_plan would compile a second time for the same answer).
+    txt = hloscan.compiled_text(plan, direction, dims)
+    census = hloscan.collective_census(txt)
+    staged = None
+    if any(r.kind == "payload" for r in contract.rules):
+        staged = hloscan.staged_exchange_total(plan, direction, dims)
+    violations = [str(v) for v in
+                  contracts.check_contract(contract, census, txt, staged)]
+    if not no_jaxprlint:
+        violations += [str(f) for f in
+                       jaxprlint.lint_plan(plan, direction, dims)]
+    return dict(combo, contract=contract.name,
+                census={k: v for k, v in census.items() if v},
+                violations=violations, ok=not violations)
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead-off fingerprint pins
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _env(key: str, value: Optional[str]) -> Iterator[None]:
+    old = os.environ.get(key)
+    try:
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old
+
+
+def run_pins(ndev: int, families: Sequence[str]) -> List[Dict[str, Any]]:
+    """The byte-identity pins, one per family x {obs, inject, enforce}:
+
+    * obs    — compiled HLO with observability enabled == disabled;
+    * inject — a build after setting THEN UNSETTING ``$DFFT_FAULT_SPEC``
+      == the never-faulted build (and the faulted+guarded build differs,
+      so the comparison is not vacuous);
+    * enforce — ``guards="enforce"`` compiles the same op graph as
+      ``"check"`` (the difference is host-side policy), which is why the
+      matrix sweeps off/check only.
+    """
+    from distributedfft_tpu import obs
+    from distributedfft_tpu.obs import tracing as _tracing
+    from distributedfft_tpu.resilience import inject
+
+    from . import hloscan
+
+    out = []
+    # Pin the obs-OFF side explicitly: $DFFT_OBS_DIR auto-enables tracing,
+    # so without the disable() an obs-on-vs-obs-on comparison would pass
+    # vacuously. The caller's obs state (env- or enable()-driven) is
+    # restored afterwards.
+    prev_state = (_tracing._FORCED_DIR, _tracing._FORCE_OFF)
+    try:
+        for family in families:
+            def fp(wire: str = "native", guards: str = "off") -> str:
+                plan, dims = _make_plan(family, "a2a", wire, guards,
+                                        "ZY_Then_X", ndev)
+                return hloscan.plan_fingerprint(plan, "forward", dims)
+
+            obs.disable()
+            base = fp()
+            with tempfile.TemporaryDirectory() as td:
+                obs.enable(td)
+                try:
+                    on = fp()
+                finally:
+                    obs.disable()
+            out.append(dict(pin=f"{family}/obs-zero-overhead",
+                            ok=on == base,
+                            detail="compiled HLO obs-on == obs-off"))
+            with _env(inject.ENV_VAR, "wire:bitflip"):
+                faulted = fp(guards="check")
+            after = fp()
+            checked = fp(guards="check")
+            # Non-vacuity isolates the INJECTION: faulted-guarded vs
+            # unfaulted-guarded (same guard mode) — a dead injector would
+            # make these equal even though both differ from guards-off.
+            out.append(dict(
+                pin=f"{family}/inject-zero-overhead",
+                ok=(after == base) and (faulted != checked),
+                detail="fault spec set-then-unset leaves the op graph "
+                       "byte-identical (faulted guarded build differs "
+                       "from the unfaulted guarded one)"))
+            out.append(dict(
+                pin=f"{family}/enforce-eq-check",
+                ok=fp(guards="enforce") == checked,
+                detail="guards=enforce compiles the op graph of "
+                       "guards=check"))
+    finally:
+        _tracing._FORCED_DIR, _tracing._FORCE_OFF = prev_state
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mutations (the verifier verifying itself)
+# ---------------------------------------------------------------------------
+
+def run_mutation(name: str, ndev: int) -> Dict[str, Any]:
+    """Break one contract on purpose and run the focused combo. The
+    result's ``violations`` MUST be non-empty and name the right
+    contract/lint — asserted by ``--mutate all`` and the test suite."""
+    import dataclasses
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import params as pm
+    from distributedfft_tpu.parallel import transpose as tr
+
+    from . import contracts, jaxprlint
+
+    if name == "drop-decode":
+        # Drop the wire_decode: bitcast the bf16 planes away so NO convert
+        # -from-bf16 remains (shapes/dtypes stay trace-valid; the payload
+        # silently lost its mantissa restoration).
+        import jax
+        import jax.numpy as jnp
+
+        real_decode = tr.wire_decode
+
+        def broken_decode(y, dtype, wire=tr.WIRE_BF16):
+            if wire == tr.WIRE_NATIVE:
+                return real_decode(y, dtype, wire)
+            import numpy as np
+            f = (jnp.float64 if np.dtype(dtype) == np.complex128
+                 else jnp.float32)
+            z = jax.lax.bitcast_convert_type(y, jnp.int16).astype(f)
+            return jax.lax.complex(z[0], z[1])
+
+        tr.wire_decode = broken_decode
+        try:
+            plan = dfft.SlabFFTPlan(
+                dfft.GlobalSize(16, 16, 16), pm.SlabPartition(ndev),
+                dfft.Config(wire_dtype="bf16", use_wisdom=False))
+            violations = [str(f) for f in
+                          jaxprlint.lint_plan(plan, "forward")]
+        finally:
+            tr.wire_decode = real_decode
+        return dict(mutation=name, violations=violations,
+                    expect="unpaired wire_encode/wire_decode")
+    plan, dims = _make_plan("slab", "opt1", "native", "off", "ZY_Then_X",
+                            ndev)
+    contract = contracts.contract_for(plan, "forward", dims)
+    if name == "bogus-census":
+        # Force an extra all-to-all via a bogus contract: expect 2 where
+        # the realigned rendering stages exactly 1.
+        rules = tuple(
+            dataclasses.replace(r, value=2)
+            if r.kind == "census" and r.op == "all_to_all" else r
+            for r in contract.rules)
+        expect = "census all_to_all == 2"
+    elif name == "flip-forbidden":
+        # Flip a forbidden-op rule: forbid the very collective the
+        # rendering legitimately stages.
+        rules = contract.rules + (contracts.Rule(
+            "forbid", "all-to-all", why="mutated: forbidden on purpose"),)
+        expect = "forbid 'all-to-all'"
+    else:
+        raise ValueError(f"unknown mutation {name!r}")
+    mutated = dataclasses.replace(contract, rules=rules)
+    violations = [str(v) for v in
+                  contracts.verify_plan(plan, "forward", dims,
+                                        contract=mutated)]
+    return dict(mutation=name, violations=violations, expect=expect)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def _combo_label(r: Dict[str, Any]) -> str:
+    seq = r.get("sequence") or "-"
+    return (f"{r['family']:<8} {r['rendering']:<8} {seq:<10} "
+            f"{r['direction'][:3]:<4} {r['wire']:<7} {r['guards']:<6}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.emulate_devices:
+        from distributedfft_tpu.parallel.mesh import force_cpu_devices
+        force_cpu_devices(args.emulate_devices)
+
+    import jax
+
+    ndev = len(jax.devices())
+    platform = jax.devices()[0].platform
+
+    if args.mutate:
+        names = MUTATIONS if args.mutate == "all" else (args.mutate,)
+        all_caught = True
+        for name in names:
+            res = run_mutation(name, ndev)
+            caught = any(res["expect"] in v for v in res["violations"])
+            all_caught &= caught
+            print(f"mutation {name}: "
+                  + ("CAUGHT" if caught else "NOT CAUGHT (verifier bug!)"))
+            for v in res["violations"]:
+                print(f"  {v}")
+        if args.mutate == "all":
+            # Self-test semantics: success = every mutation caught.
+            print("mutation self-test: "
+                  + ("PASS" if all_caught else "FAIL"))
+            return 0 if all_caught else 1
+        # Single-mutation semantics: behave like a verify run of the
+        # broken combo — violations mean a non-zero exit.
+        return 1 if res["violations"] else 0
+
+    report: Dict[str, Any] = {
+        "devices": ndev, "platform": platform,
+        "combos": [], "pins": [], "srclint": [],
+    }
+    failures = 0
+    print(f"dfft-verify: {ndev} device(s) on {platform}")
+    print(f"{'family':<8} {'render':<8} {'sequence':<10} {'dir':<4} "
+          f"{'wire':<7} {'guards':<6} {'contract':<18} result")
+    for combo in iter_combos(args, ndev):
+        try:
+            res = run_combo(combo, ndev, no_jaxprlint=args.no_jaxprlint)
+        except Exception as e:  # noqa: BLE001 — a combo that cannot even
+            # build/lower must land in the table, not abort the matrix.
+            res = dict(combo, contract="-", census={},
+                       violations=[f"build/lower failed: "
+                                   f"{type(e).__name__}: {e}"], ok=False)
+        report["combos"].append(res)
+        status = "PASS" if res["ok"] else "FAIL"
+        if not res["ok"]:
+            failures += 1
+        print(f"{_combo_label(res)} {res['contract']:<18} {status}")
+        for v in res["violations"]:
+            print(f"    {v}")
+
+    if not args.no_pins:
+        fams = [f for f in _csv(args.families)]
+        for pin in run_pins(ndev, fams):
+            report["pins"].append(pin)
+            status = "PASS" if pin["ok"] else "FAIL"
+            if not pin["ok"]:
+                failures += 1
+            print(f"pin  {pin['pin']:<38} {status}  ({pin['detail']})")
+
+    if not args.no_srclint:
+        from . import srclint
+        findings = srclint.lint_repo()
+        for f in findings:
+            report["srclint"].append(str(f))
+            failures += 1
+            print(f"srclint FAIL {f}")
+        if not findings:
+            print("srclint: clean "
+                  "(traced-host-io, host-only-jnp, wisdom-flock)")
+
+    n = len(report["combos"])
+    npins = len(report["pins"])
+    verdict = "PASS" if failures == 0 else f"FAIL ({failures} failure(s))"
+    print(f"dfft-verify: {n} combo(s), {npins} pin(s), "
+          f"srclint {'skipped' if args.no_srclint else 'ran'} -> {verdict}")
+    report["failures"] = failures
+    report["ok"] = failures == 0
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"report written to {args.json}")
+    if args.obs:
+        from distributedfft_tpu import obs
+        print("obs metrics: "
+              + json.dumps(obs.metrics.snapshot(), sort_keys=True))
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

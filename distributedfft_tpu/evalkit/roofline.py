@@ -31,10 +31,9 @@ row measured under those toggles must not be fed to ``roofline_rows``
 
 from __future__ import annotations
 
-import math
 import os
 import re
-from typing import Dict, Optional
+from typing import Optional
 
 from ..ops.mxu_fft import DIRECT_MAX, _R2_BASE, _split
 

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..ops import fft as local_fft
-from ..params import Config, FFTNorm, GlobalSize, Partition
+from ..params import Config, GlobalSize, Partition
 from ..resilience import fallback, guards
 from ..utils import wisdom
 

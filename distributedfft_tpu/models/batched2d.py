@@ -543,3 +543,34 @@ class Batched2DFFTPlan:
             [("1D FFT X-Direction", first, self._out_spec, self._out_spec),
              (self._xpose_desc(), xpose, self._out_spec, self._in_spec),
              ("1D FFT Y-Direction", last, self._in_spec, self._in_spec)])
+
+# ---------------------------------------------------------------------------
+# contract declaration (analysis/contracts.py) — the exchange this family
+# stages, next to the code that stages it.
+# ---------------------------------------------------------------------------
+
+def _contract_exchanges(plan, direction, dims=2):
+    """Batched-2D: ``shard="x"`` stages one exchange (scatter spectral y,
+    gather x; STREAMS chunks along the untouched batch axis);
+    ``shard="batch"`` and the single-device fallback are collective-free
+    by construction."""
+    del direction, dims
+    if plan.fft3d or plan.shard == "batch":
+        return ()
+    from ..analysis import contracts as _c
+    cfg = plan.config
+    rendering = _c.rendering_name(cfg)
+    chunks = 1
+    if rendering == "streams":
+        chunks = min(cfg.resolved_streams_chunks(), plan._batch_pad)
+    return (_c.ExchangeDecl(
+        "transpose", (plan._batch_pad, plan._nx_pad, plan._nys_pad),
+        plan.partition.num_ranks, rendering, chunks),)
+
+
+def _register_contracts():
+    from ..analysis import contracts as _c
+    _c.register_family("batched2d", "Batched2DFFTPlan", _contract_exchanges)
+
+
+_register_contracts()

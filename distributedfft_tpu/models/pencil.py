@@ -846,3 +846,48 @@ class PencilFFTPlan(DistFFTPlan):
         run, _ = guards.maybe_wrap(self, run, "inverse", dims)
         return jax.jit(run)
 
+
+# ---------------------------------------------------------------------------
+# contract declaration (analysis/contracts.py) — the exchanges this family
+# stages at each partial-transform depth, next to the code that stages them.
+# ---------------------------------------------------------------------------
+
+def _contract_exchanges(plan, direction, dims=3):
+    """Pencil: transpose 1 over p2 (scatter z, gather y; free axis x,
+    chunk axis 0 sharded over p1) from dims >= 2, transpose 2 over p1
+    (scatter y, gather x; free axis z, chunk axis 2 sharded over p2)
+    from dims >= 3. Payloads are the padded spectral volumes both
+    transposes move (``spec_for`` shapes)."""
+    del direction  # both transposes run (mirrored) in both directions
+    if plan.fft3d:
+        return ()
+    from ..analysis import contracts as _c
+    cfg = plan.config
+    out = []
+    if dims >= 2 and plan.p2 > 1:
+        r1 = _c.rendering_name(cfg)
+        k1 = 1
+        if r1 == "streams":
+            k1 = min(cfg.resolved_streams_chunks(),
+                     plan._nx_p1 // plan.p1)
+        out.append(_c.ExchangeDecl(
+            "transpose 1", (plan._nx_p1, plan._ny_p2, plan._nzc_p2),
+            plan.p2, r1, k1))
+    if dims >= 3 and plan.p1 > 1:
+        r2 = _c.rendering_name(cfg, second=True)
+        k2 = 1
+        if r2 == "streams":
+            k2 = min(cfg.resolved_streams_chunks(),
+                     plan._nzc_p2 // plan.p2)
+        out.append(_c.ExchangeDecl(
+            "transpose 2", (plan._nx_p1, plan._ny_p1, plan._nzc_p2),
+            plan.p1, r2, k2))
+    return tuple(out)
+
+
+def _register_contracts():
+    from ..analysis import contracts as _c
+    _c.register_family("pencil", "PencilFFTPlan", _contract_exchanges)
+
+
+_register_contracts()

@@ -801,3 +801,41 @@ class SlabFFTPlan(DistFFTPlan):
 
 
 
+
+# ---------------------------------------------------------------------------
+# contract declaration (analysis/contracts.py) — the exchange this family
+# stages, declared next to the code that stages it so the verifier and the
+# pipeline cannot drift apart.
+# ---------------------------------------------------------------------------
+
+def _contract_exchanges(plan, direction, dims=3):
+    """Slab: one symmetric global exchange per direction (scatter the
+    sequence's split axis, gather x), payload = the padded spectral
+    volume. The single-device fallback stages none."""
+    del direction, dims  # the slab exchange is direction-symmetric
+    if plan.fft3d:
+        return ()
+    from ..analysis import contracts as _c
+    cfg = plan.config
+    rendering = _c.rendering_name(cfg)
+    # The exchanged block carries BOTH paddings: the split axis padded to
+    # the mesh (output_padded_shape) AND x padded to nx_pad — the forward
+    # `last` stage slices x back to nx only after the exchange.
+    payload = list(plan.output_padded_shape)
+    payload[0] = plan._nx_pad
+    chunks = 1
+    if rendering == "streams":
+        # chunk_slices clamps the piece count to the free-axis extent at
+        # trace time; mirror it so the expected all-to-all count is exact.
+        ca = plan._streams_chunk_axis()
+        chunks = min(cfg.resolved_streams_chunks(), payload[ca])
+    return (_c.ExchangeDecl("transpose", tuple(payload),
+                            plan._P, rendering, chunks),)
+
+
+def _register_contracts():
+    from ..analysis import contracts as _c
+    _c.register_family("slab", "SlabFFTPlan", _contract_exchanges)
+
+
+_register_contracts()

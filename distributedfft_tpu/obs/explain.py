@@ -272,6 +272,34 @@ def _census_lines(compiled) -> list:
     return ["  " + "  ".join(f"{k}: {c[k]}" for k in order)]
 
 
+def _contract_line(plan, compiled, dims: int) -> str:
+    """The one-line contract verdict, sourced from the SAME registry and
+    checker ``dfft-verify`` runs (``analysis/contracts.py``) — explain
+    and verify cannot disagree about whether this program honors its
+    declared contract."""
+    from ..analysis import contracts, hloscan
+    try:
+        contract = contracts.contract_for(plan, "forward", dims)
+    except KeyError:
+        return "  contract: unverified (no contract registered for this " \
+               "plan family)"
+    try:
+        txt = compiled.as_text()
+        census = hloscan.collective_census(txt)
+        staged = None
+        if any(r.kind == "payload" for r in contract.rules):
+            staged = hloscan.staged_exchange_total(plan, "forward", dims)
+        violations = contracts.check_contract(contract, census, txt, staged)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not abort
+        return f"  contract: unverified ({type(e).__name__}: {e})"
+    if violations:
+        return (f"  contract: VIOLATED [{contract.name}] — "
+                + "; ".join(str(v) for v in violations))
+    return f"  contract: verified ({contract.name}, " \
+           f"{len(contract.rules)} rule(s); dfft-verify runs the full " \
+           "matrix)"
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -478,8 +506,13 @@ def main(argv=None) -> int:
                         tuple(plan.input_padded_shape), rdt)
                     compiled = fn.lower(arg).compile()
                 out.extend(_census_lines(compiled))
+                out.append(_contract_line(plan, compiled, dims))
             except Exception as e:  # noqa: BLE001 — census is best-effort
                 out.append(f"  unavailable: {type(e).__name__}: {e}")
+        else:
+            out.append("hlo census: skipped (--no-compile)")
+            out.append("  contract: unverified (needs the compiled module "
+                       "— drop --no-compile or run dfft-verify)")
 
         out.append("roofline (evalkit/roofline.py):")
         out.extend(_roofline_lines(args, kind, cfg.fft_backend))

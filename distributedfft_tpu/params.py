@@ -192,7 +192,7 @@ class GlobalSize:
     ny: int
     nz: int
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         for name in ("nx", "ny", "nz"):
             v = getattr(self, name)
             if not isinstance(v, int) or v <= 0:
@@ -501,7 +501,7 @@ class Config:
             # mode fails at Config construction, not at first exec.
             object.__setattr__(self, "guards", parse_guards(self.guards))
 
-    def mxu_settings(self):
+    def mxu_settings(self) -> Optional[object]:
         """The plan's ``mxu_fft.MXUSettings``, or None when every knob is
         None — None lets the deprecated ``set_*`` process defaults keep
         applying wholesale, preserving pre-Config behavior. When any knob

@@ -23,7 +23,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .. import obs
 from ..parallel.mesh import make_pencil_mesh, make_slab_mesh
 from ..parallel.transpose import all_to_all_transpose, realigned_pack_shape
 
@@ -41,15 +40,6 @@ def _time_fn(fn, x, iterations: int, warmup: int) -> float:
 
 _COLLECTIVE_OPS = ("all-to-all", "collective-permute", "all-gather",
                    "reduce-scatter", "all-reduce")
-
-# Exchange collectives and their async start forms, as (json key, HLO op
-# mnemonic) pairs. Counted as op INSTANCES — "<op>(" with the opening
-# paren — so "all-to-all(" does not match the async "all-to-all-start("
-# form and vice versa.
-_ASYNC_HLO_FORMS = (("all_to_all", "all-to-all"),
-                    ("all_to_all_start", "all-to-all-start"),
-                    ("collective_permute", "collective-permute"),
-                    ("collective_permute_start", "collective-permute-start"))
 
 
 def async_collective_counts(hlo) -> Dict[str, int]:
@@ -72,18 +62,15 @@ def async_collective_counts(hlo) -> Dict[str, int]:
     (tests/test_wire.py) asserts the compression did NOT break the
     ``>= P-1`` collective-permute signature of ring plans: if GSPMD ever
     re-fused the encoded permutes, the permute count would collapse and
-    the gate fails by count, not by timing drift."""
-    txt = hlo if isinstance(hlo, str) else hlo.as_text()
-    out = {name: txt.count(f" {op}(") for name, op in _ASYNC_HLO_FORMS}
-    out["async_total"] = (out["all_to_all_start"]
-                          + out["collective_permute_start"])
-    out["convert"] = txt.count(" convert(")
-    # Mirror the census into the obs registry (``hlo.*`` gauges — last
-    # census wins), so a bench/explain run's collective counts land in the
-    # metrics snapshot without every caller re-plumbing them.
-    for name, v in out.items():
-        obs.metrics.gauge(f"hlo.{name}", v)
-    return out
+    the gate fails by count, not by timing drift.
+
+    Since the analysis subsystem landed this delegates to the canonical
+    counter (``analysis.hloscan.collective_census`` — which also mirrors
+    the census into the obs ``hlo.*`` gauges); the name stays because the
+    bench/eval layers and their JSON schemas grew around it."""
+    from ..analysis.hloscan import collective_census
+
+    return collective_census(hlo)
 
 
 # Module-level so repeated calls (one per bf16 twin in a race, plus the

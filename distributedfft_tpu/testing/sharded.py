@@ -25,14 +25,13 @@ a reshard; a broadcast multiply fuses into the reduction).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from .. import params as pm
 from ..models.pencil import PencilFFTPlan
 from ..models.slab import SlabFFTPlan
 

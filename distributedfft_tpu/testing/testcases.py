@@ -27,8 +27,7 @@ gathered, matching the reference's warmup counter.
 
 from __future__ import annotations
 
-import os
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -25,8 +25,6 @@ any partition/mesh the underlying plans accept.
 
 from __future__ import annotations
 
-import numpy as np
-
 from .. import params as pm
 from ..models.batched2d import Batched2DFFTPlan
 from ..models.slab import SlabFFTPlan

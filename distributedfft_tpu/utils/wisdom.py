@@ -67,7 +67,7 @@ import json
 import os
 import tempfile
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 try:
     from .. import obs
@@ -80,23 +80,23 @@ except ImportError:
     class _NullObs:  # noqa: D401 — minimal stand-in
         class metrics:
             @staticmethod
-            def inc(name, n=1):
+            def inc(name: str, n: int = 1) -> None:
                 pass
 
             @staticmethod
-            def gauge(name, value):
+            def gauge(name: str, value: Any) -> None:
                 pass
 
         @staticmethod
-        def span(name, **attrs):
+        def span(name: str, **attrs: Any) -> Any:
             return _contextlib.nullcontext()
 
         @staticmethod
-        def event(name, **attrs):
+        def event(name: str, **attrs: Any) -> None:
             pass
 
         @staticmethod
-        def notice(msg, **attrs):
+        def notice(msg: str, **attrs: Any) -> None:
             pass
 
     obs = _NullObs()
@@ -108,7 +108,7 @@ except ImportError:
     # inactive, like every other wisdom failure mode.
     class _inject:  # noqa: D401 — minimal stand-in
         @staticmethod
-        def lock_contended():
+        def lock_contended() -> bool:
             return False
 
 WISDOM_VERSION = 3
@@ -172,7 +172,7 @@ def open_store(path: Optional[str] = None,
     return WisdomStore(p) if p else None
 
 
-def store_for_config(config) -> Optional["WisdomStore"]:
+def store_for_config(config: Any) -> Optional["WisdomStore"]:
     """The store a Config selects (``wisdom_path``/``use_wisdom`` fields)."""
     return open_store(getattr(config, "wisdom_path", None),
                       getattr(config, "use_wisdom", True))
@@ -193,7 +193,7 @@ def _lock_stale_s() -> float:
 
 
 @contextlib.contextmanager
-def _advisory_lock(path: str):
+def _advisory_lock(path: str) -> Iterator[None]:
     """Best-effort exclusive ``fcntl.flock`` on ``path + '.lock'``,
     serializing the read-merge-replace window across processes sharing one
     store — with BOUNDED acquisition (resilience leg 4): the old blocking
@@ -301,7 +301,7 @@ class WisdomStore:
     """One JSON wisdom file; every read is tolerant, every write atomic
     (and advisory-locked against concurrent recorders)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str) -> None:
         self.path = os.path.expanduser(str(path))
 
     # -- raw I/O -----------------------------------------------------------
@@ -417,7 +417,7 @@ def _device_fingerprint() -> Dict[str, str]:
             "jax": jax.__version__}
 
 
-def _decomp_desc(kind: str, partition, sequence=None,
+def _decomp_desc(kind: str, partition: Any, sequence: Any = None,
                  variant: Optional[str] = None) -> str:
     from .. import params as pm
     if isinstance(partition, pm.PencilPartition):
@@ -433,7 +433,8 @@ def _decomp_desc(kind: str, partition, sequence=None,
 
 
 def plan_key(kind: str, global_shape: Sequence[int], double_prec: bool,
-             partition, norm, transform: str = "r2c", sequence=None,
+             partition: Any, norm: Any, transform: str = "r2c",
+             sequence: Any = None,
              variant: Optional[str] = None,
              mesh_shape: Optional[Dict[str, int]] = None,
              dims: int = 3) -> str:
@@ -469,7 +470,7 @@ def local_key(shape: Sequence[int], double_prec: bool) -> str:
     return json.dumps(parts, sort_keys=True, separators=(",", ":"))
 
 
-def _mesh_shape_of(mesh, partition) -> Dict[str, int]:
+def _mesh_shape_of(mesh: Any, partition: Any) -> Dict[str, int]:
     if mesh is not None:
         return {str(k): int(v) for k, v in dict(mesh.shape).items()}
     # The mesh a plan WILL build is fully determined by the partition.
@@ -486,7 +487,7 @@ def _mesh_shape_of(mesh, partition) -> Dict[str, int]:
 # record helpers (shared by resolution, the CLIs and bench.py)
 # ---------------------------------------------------------------------------
 
-def local_fft_record(candidate) -> Dict[str, Any]:
+def local_fft_record(candidate: Any) -> Dict[str, Any]:
     """Serialize a winning ``autotune.Candidate``."""
     import numpy as np
     rec = {"fft_backend": candidate.backend,
@@ -499,7 +500,7 @@ def local_fft_record(candidate) -> Dict[str, Any]:
     return rec
 
 
-def comm_record(candidate, base_config=None) -> Dict[str, Any]:
+def comm_record(candidate: Any, base_config: Any = None) -> Dict[str, Any]:
     """Serialize a winning ``autotune.CommCandidate``. ``send=None``
     candidates were timed with the BASE config's send method; pass the base
     that was actually raced (``base_config``) so a non-SYNC base (the CLI
@@ -549,7 +550,8 @@ def comm_record(candidate, base_config=None) -> Dict[str, Any]:
     return rec
 
 
-def wire_record(candidate, budget: Optional[float] = None) -> Dict[str, Any]:
+def wire_record(candidate: Any,
+                budget: Optional[float] = None) -> Dict[str, Any]:
     """Serialize an ``autotune_wire`` winner for the ``wire`` slot (the
     wire-only race: comm explicit, ``wire_dtype="auto"``). ``budget`` is
     the error budget the race ran under (recorded so a later LOOSER
@@ -605,14 +607,14 @@ def _valid_local_rec(rec: Dict[str, Any]) -> bool:
     return dm is None or (isinstance(dm, int) and dm >= 1)
 
 
-def _fold_local_rec(cfg, rec):
+def _fold_local_rec(cfg: Any, rec: Dict[str, Any]) -> Any:
     import dataclasses as dc
     return dc.replace(cfg, fft_backend=rec["fft_backend"],
                       mxu_precision=rec.get("mxu_precision"),
                       mxu_direct_max=rec.get("mxu_direct_max"))
 
 
-def _fold_comm_rec(cfg, rec):
+def _fold_comm_rec(cfg: Any, rec: Dict[str, Any]) -> Any:
     """Fold a stored comm record into a Config; raises on stale/invalid
     fields (callers treat that as a miss)."""
     import dataclasses as dc
@@ -639,7 +641,7 @@ def _fold_comm_rec(cfg, rec):
     return dc.replace(cfg, wire_dtype=wire)
 
 
-def _fold_wire_rec(cfg, rec):
+def _fold_wire_rec(cfg: Any, rec: Dict[str, Any]) -> Any:
     """Fold a stored ``wire``-slot record into a Config; raises on
     stale/invalid fields (callers treat that as a miss)."""
     import dataclasses as dc
@@ -649,7 +651,7 @@ def _fold_wire_rec(cfg, rec):
     return dc.replace(cfg, wire_dtype=wire)
 
 
-def _wire_hit_within_budget(rec, budget: float) -> bool:
+def _wire_hit_within_budget(rec: Dict[str, Any], budget: float) -> bool:
     """Whether a recorded wire winner satisfies the CALLER'S error budget.
     The budget is not part of the plan key (two runs differing only in
     ``wire_error_budget`` share an entry), so the check happens at fold
@@ -674,7 +676,8 @@ def _wire_hit_within_budget(rec, budget: float) -> bool:
     return budget <= raced
 
 
-def _no_collectives(kind: str, partition, variant, dims: int) -> bool:
+def _no_collectives(kind: str, partition: Any, variant: Any,
+                    dims: int) -> bool:
     """Whether a plan configuration issues no exchange at all (single
     rank, the embarrassingly-parallel batched2d batch sharding, or a
     dims<2 partial transform): its comm/wire 'auto' markers resolve to
@@ -687,7 +690,8 @@ def _no_collectives(kind: str, partition, variant, dims: int) -> bool:
     return single or dims < 2
 
 
-def _comm_hit_fold(norm_base, rec, race_wire: bool, budget: float):
+def _comm_hit_fold(norm_base: Any, rec: Dict[str, Any], race_wire: bool,
+                   budget: float) -> Any:
     """``(folded Config or None, miss-reason or None)`` for a stored
     ``comm`` record — the single hit/miss decision shared by
     ``_resolve_comm`` and the lookup-only ``peek_config`` (dfft-explain),
@@ -724,7 +728,7 @@ def _comm_hit_fold(norm_base, rec, race_wire: bool, budget: float):
     return folded, None
 
 
-def _wire_hit_fold(base, rec, budget: float):
+def _wire_hit_fold(base: Any, rec: Dict[str, Any], budget: float) -> Any:
     """``(folded Config or None, miss-reason or None)`` for a stored
     ``wire``-slot record (shared by ``_resolve_wire`` and
     ``peek_config``)."""
@@ -742,7 +746,7 @@ def _wire_hit_fold(base, rec, budget: float):
     return folded, None
 
 
-def _describe_comm(cfg) -> str:
+def _describe_comm(cfg: Any) -> str:
     """Compact human label of a resolved comm/send/opt/wire choice (the
     provenance notices and dfft-explain share it)."""
     from .. import params as pm
@@ -759,7 +763,7 @@ def _describe_comm(cfg) -> str:
     return tag
 
 
-def _hit_notice(slot: str, detail: str, store) -> None:
+def _hit_notice(slot: str, detail: str, store: Any) -> None:
     obs.metrics.inc("wisdom.hits")
     src = store.path if store is not None else "no store"
     obs.notice(f"wisdom[{slot}]: hit ({detail}) <- {src}",
@@ -767,7 +771,8 @@ def _hit_notice(slot: str, detail: str, store) -> None:
                detail=detail, store=getattr(store, "path", None))
 
 
-def _miss_notice(slot: str, reason: str, store, action: str) -> None:
+def _miss_notice(slot: str, reason: str, store: Any,
+                 action: str) -> None:
     obs.metrics.inc("wisdom.misses")
     src = store.path if store is not None else "no store configured"
     obs.notice(f"wisdom[{slot}]: miss ({reason}; {src}) -> {action}",
@@ -817,7 +822,7 @@ def resolve_local_backend(shape: Sequence[int], double_prec: bool = False,
 # construction-time resolution of Config "auto" fields
 # ---------------------------------------------------------------------------
 
-def unresolved(config) -> bool:
+def unresolved(config: Any) -> bool:
     """True when the Config still carries an 'auto' the engines should have
     resolved at plan construction."""
     from .. import params as pm
@@ -825,7 +830,7 @@ def unresolved(config) -> bool:
                        config.comm_method2, config.wire_dtype)
 
 
-def _race_shape(kind: str, global_size, partition,
+def _race_shape(kind: str, global_size: Any, partition: Any,
                 variant: Optional[str]) -> Tuple[int, ...]:
     """The per-rank block the plan's local transforms actually see — what
     the local-FFT race should time (racing the full global cube on one
@@ -846,8 +851,9 @@ def _race_shape(kind: str, global_size, partition,
     return tuple(shape)
 
 
-def _resolve_local_fft(cfg, store, key, kind, global_size, partition,
-                       variant):
+def _resolve_local_fft(cfg: Any, store: Any, key: str, kind: str,
+                       global_size: Any, partition: Any,
+                       variant: Any) -> Any:
     import dataclasses as dc
 
     rec = store.lookup(key, "local_fft") if store else None
@@ -879,7 +885,7 @@ def _resolve_local_fft(cfg, store, key, kind, global_size, partition,
     return cfg
 
 
-def _comm_defaults(cfg):
+def _comm_defaults(cfg: Any) -> Any:
     """Clear comm/wire 'auto' markers to the dataclass defaults (used when
     the plan issues no collectives, or when every raced strategy failed —
     the wire default is the bit-identical native, never a silent lossy
@@ -897,7 +903,7 @@ def _comm_defaults(cfg):
     return dc.replace(cfg, **kw) if kw else cfg
 
 
-def _send_encoding():
+def _send_encoding() -> Tuple[Any, ...]:
     """The index-based SendMethod wire order shared by the multihost
     broadcast encoders/decoders (``_broadcast_comm_hit``,
     ``_agree_across_processes``) — enum definition order, defined once so
@@ -906,7 +912,7 @@ def _send_encoding():
     return tuple(pm.SendMethod)
 
 
-def _broadcast_comm_hit(folded, base):
+def _broadcast_comm_hit(folded: Any, base: Any) -> Any:
     """Process 0's hit/miss decision, agreed everywhere: a per-host wisdom
     store can hit on some processes and miss on others, and a process that
     skips the race while its peers run collective plan timings deadlocks
@@ -948,8 +954,10 @@ def _broadcast_comm_hit(folded, base):
         wire_dtype=_WIRE_CONCRETE[int(vec[6])])
 
 
-def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
-                  sequence, transform, dims, variant):
+def _resolve_comm(cfg: Any, store: Any, key: str, kind: str,
+                  global_size: Any, partition: Any, mesh: Any,
+                  sequence: Any, transform: str, dims: int,
+                  variant: Any) -> Any:
     import dataclasses as dc
 
     import jax
@@ -1000,7 +1008,7 @@ def _resolve_comm(cfg, store, key, kind, global_size, partition, mesh,
     return cfg
 
 
-def _broadcast_wire_hit(folded, base):
+def _broadcast_wire_hit(folded: Any, base: Any) -> Any:
     """Process 0's wire hit/miss decision, agreed everywhere (the wire
     race times collective plans, so a per-host hit/miss split deadlocks —
     same contract as ``_broadcast_comm_hit``)."""
@@ -1016,8 +1024,10 @@ def _broadcast_wire_hit(folded, base):
     return dc.replace(base, wire_dtype=_WIRE_CONCRETE[code])
 
 
-def _resolve_wire(cfg, store, key, kind, global_size, partition, mesh,
-                  sequence, transform, dims, variant):
+def _resolve_wire(cfg: Any, store: Any, key: str, kind: str,
+                  global_size: Any, partition: Any, mesh: Any,
+                  sequence: Any, transform: str, dims: int,
+                  variant: Any) -> Any:
     """Resolve ``wire_dtype="auto"`` when the comm choice is EXPLICIT
     (comm "auto" resolves both axes in one race — ``_resolve_comm``):
     wisdom ``wire``-slot hit -> reuse; miss -> race native vs bf16 on the
@@ -1066,7 +1076,7 @@ def _resolve_wire(cfg, store, key, kind, global_size, partition, mesh,
     return cfg
 
 
-def _agree_across_processes(cfg):
+def _agree_across_processes(cfg: Any) -> Any:
     """Multi-controller runs must agree on the resolved Config: measured
     winners are routinely within noise across processes, and divergent
     Configs build mismatched collective programs (hang). Broadcast process
@@ -1112,9 +1122,10 @@ def _agree_across_processes(cfg):
         wire_dtype=_WIRE_CONCRETE[int(vec[8])])
 
 
-def resolve_config(kind: str, global_size, partition, config=None, *,
-                   mesh=None, sequence=None, transform: str = "r2c",
-                   dims: int = 3, variant: Optional[str] = None):
+def resolve_config(kind: str, global_size: Any, partition: Any,
+                   config: Any = None, *, mesh: Any = None,
+                   sequence: Any = None, transform: str = "r2c",
+                   dims: int = 3, variant: Optional[str] = None) -> Any:
     """Resolve a Config's ``fft_backend="auto"`` / ``comm_method="auto"``
     / ``wire_dtype="auto"`` markers into measured concrete values: wisdom
     hit -> reuse silently; miss -> bounded race (accuracy-gated by the
@@ -1154,9 +1165,11 @@ def resolve_config(kind: str, global_size, partition, config=None, *,
         return _agree_across_processes(cfg)
 
 
-def peek_config(kind: str, global_size, partition, config=None, *,
-                mesh=None, sequence=None, transform: str = "r2c",
-                dims: int = 3, variant: Optional[str] = None):
+def peek_config(kind: str, global_size: Any, partition: Any,
+                config: Any = None, *, mesh: Any = None,
+                sequence: Any = None, transform: str = "r2c",
+                dims: int = 3,
+                variant: Optional[str] = None) -> Tuple[Any, Dict[str, Any]]:
     """LOOKUP-ONLY resolution + provenance: ``(cfg, provenance)``.
 
     The ``dfft-explain`` surface — it must report the fully resolved plan

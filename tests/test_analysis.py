@@ -1,0 +1,385 @@
+"""Static-analysis subsystem (``distributedfft_tpu/analysis/``) tests.
+
+* contracts resolve and verify clean on representative rendering x wire x
+  guard combos of all three families (the FULL matrix runs as the CI
+  ``dfft-verify`` job — here a spread that covers every rule kind);
+* MUTATION tests: break a contract on purpose (drop a ``wire_decode``,
+  force an extra all-to-all via a bogus contract, flip a forbidden-op
+  rule) and assert the verifier fails with a diagnostic NAMING the
+  violated contract — a verifier that cannot fail verifies nothing;
+* unit contracts of the scanners: census text parsing (moved here from
+  test_microbench when the counter moved to ``analysis.hloscan``),
+  metadata-stripped fingerprints, staged payload extraction, jaxpr
+  pairing lints on synthetic programs, AST lints on synthetic sources;
+* the ``dfft-verify`` CLI: mutation self-test exit semantics;
+* ``dfft-explain``'s contract line comes from the same registry.
+"""
+
+import dataclasses
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import params as pm
+from distributedfft_tpu.analysis import (
+    contracts,
+    hloscan,
+    jaxprlint,
+    srclint,
+    verify,
+)
+from distributedfft_tpu.parallel.transpose import wire_encode
+
+G = dfft.GlobalSize(20, 16, 16)  # uneven: padding on every decomposed axis
+
+
+def _slab(cfg_kw, seq="ZY_Then_X"):
+    return dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                            dfft.Config(use_wisdom=False, **cfg_kw),
+                            sequence=seq)
+
+
+# ---------------------------------------------------------------------------
+# contracts verify clean (representative combos; full matrix = CI job)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(comm_method=pm.CommMethod.ALL2ALL, opt=1),
+    dict(send_method=pm.SendMethod.RING, wire_dtype="bf16"),
+    dict(comm_method=pm.CommMethod.ALL2ALL,
+         send_method=pm.SendMethod.STREAMS, streams_chunks=3),
+    dict(comm_method=pm.CommMethod.PEER2PEER, guards="check"),
+], ids=["opt1", "ring-bf16", "streams3", "p2p-check"])
+@pytest.mark.parametrize("direction", ["forward", "inverse"])
+def test_slab_combos_verify_clean(devices, kw, direction):
+    plan = _slab(kw)
+    assert contracts.verify_plan(plan, direction) == []
+    assert jaxprlint.lint_plan(plan, direction) == []
+
+
+def test_pencil_mixed_renderings_verify_clean(devices):
+    """Mixed per-transpose renderings (t1 ring over p2, t2 explicit a2a
+    over p1) resolve to a composed contract and verify."""
+    plan = dfft.PencilFFTPlan(
+        G, pm.PencilPartition(2, 4),
+        dfft.Config(send_method=pm.SendMethod.RING,
+                    comm_method2=pm.CommMethod.ALL2ALL,
+                    send_method2=pm.SendMethod.SYNC, use_wisdom=False))
+    contract = contracts.contract_for(plan, "forward")
+    renders = {d.rendering for d in contract.exchanges}
+    assert renders == {"ring", "a2a"}
+    assert contracts.verify_plan(plan, "forward",
+                                 contract=contract) == []
+
+
+def test_no_exchange_contracts(devices):
+    """Single-device reference path and batch sharding: the zero-
+    collective contract."""
+    single = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
+                              pm.SlabPartition(1),
+                              dfft.Config(use_wisdom=False))
+    assert contracts.contract_for(single, "forward").exchanges == ()
+    assert contracts.verify_plan(single, "forward") == []
+    bp = dfft.Batched2DFFTPlan(8, 16, 16, pm.SlabPartition(8),
+                               dfft.Config(use_wisdom=False), shard="batch")
+    c = contracts.contract_for(bp, "forward", dims=2)
+    assert c.exchanges == ()
+    assert contracts.verify_plan(bp, "forward", dims=2, contract=c) == []
+
+
+def test_contract_payload_reconciles_ring_discount(devices):
+    """The ring contract predicts (P-1)/P of the padded payload — pinned
+    against the staged module on the uneven shape (x pad 20->24)."""
+    plan = _slab(dict(send_method=pm.SendMethod.RING))
+    contract = contracts.contract_for(plan, "forward")
+    (rule,) = [r for r in contract.rules if r.kind == "payload"]
+    # (24, 16, 9) c64 payload, 7/8 of it travelling.
+    assert rule.value == 24 * 16 * 9 * 8 * 7 // 8
+    assert hloscan.staged_exchange_total(plan, "forward") == rule.value
+
+
+def test_verify_feeds_hlo_gauges(devices):
+    from distributedfft_tpu import obs
+    obs.metrics.gauge("hlo.all_to_all", -1)
+    assert contracts.verify_plan(_slab(dict(opt=1)), "forward") == []
+    assert obs.metrics.gauge_value("hlo.all_to_all") == 1
+
+
+# ---------------------------------------------------------------------------
+# mutations: the verifier must FAIL with the right diagnostic
+# ---------------------------------------------------------------------------
+
+def test_mutation_drop_decode_caught(devices):
+    res = verify.run_mutation("drop-decode", 8)
+    assert res["violations"], "dropped wire_decode went undetected"
+    assert any("unpaired wire_encode/wire_decode" in v
+               for v in res["violations"])
+    assert any("jaxprlint/wire-pairing" in v for v in res["violations"])
+
+
+def test_mutation_bogus_census_caught(devices):
+    res = verify.run_mutation("bogus-census", 8)
+    assert any("census all_to_all == 2" in v and "[slab/a2a]" in v
+               for v in res["violations"])
+
+
+def test_mutation_flip_forbidden_caught(devices):
+    res = verify.run_mutation("flip-forbidden", 8)
+    assert any("forbid 'all-to-all'" in v and "[slab/a2a]" in v
+               for v in res["violations"])
+
+
+def test_bogus_contract_fails_verify_plan(devices):
+    """A contract demanding an extra all-to-all makes verify_plan report
+    a violation naming the census rule (the API-level mutation path)."""
+    plan = _slab(dict(opt=1))
+    contract = contracts.contract_for(plan, "forward")
+    rules = tuple(dataclasses.replace(r, value=r.value + 1)
+                  if r.kind == "census" and r.op == "all_to_all" else r
+                  for r in contract.rules)
+    bad = dataclasses.replace(contract, rules=rules)
+    violations = contracts.verify_plan(plan, "forward", contract=bad)
+    assert len(violations) == 1
+    assert violations[0].contract == "slab/a2a"
+    assert "census all_to_all == 2" in str(violations[0])
+
+
+def test_dfft_verify_cli_mutation_selftest():
+    """``dfft-verify --mutate all`` catches every mutation (rc 0); the
+    single-mutation form exits non-zero like a failed verify run."""
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.analysis.verify",
+         "--emulate-devices", "8", "--mutate", "all"],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "mutation self-test: PASS" in r.stdout
+    assert "unpaired wire_encode/wire_decode" in r.stdout
+    assert "census all_to_all == 2" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# hloscan units
+# ---------------------------------------------------------------------------
+
+def test_census_text_contract():
+    """Counting "<op>(" must not swallow the async -start form (or vice
+    versa); async_total sums only the starts; all-reduce and friends are
+    counted for the no-exchange contracts. (The canonical counter lives
+    here since testing.microbench delegated to analysis.hloscan.)"""
+    txt = """
+  %a = f32[8] all-to-all(x)
+  %b = f32[8] all-to-all-start(y)
+  %c = f32[8] collective-permute(x), source_target_pairs={{0,1}}
+  %d = f32[8] collective-permute(y), source_target_pairs={{1,0}}
+  %e = f32[8] collective-permute-start(z)
+  %f = bf16[8] convert(w)
+  %g = f32[] all-reduce(v)
+"""
+    counts = hloscan.collective_census(txt)
+    assert counts == {"all_to_all": 1, "all_to_all_start": 1,
+                      "collective_permute": 2,
+                      "collective_permute_start": 1,
+                      "all_reduce": 1, "all_reduce_start": 0,
+                      "all_gather": 0, "all_gather_start": 0,
+                      "reduce_scatter": 0, "reduce_scatter_start": 0,
+                      "async_total": 2, "convert": 1}
+
+
+def test_fingerprint_strips_metadata_only():
+    a = ('HloModule jit_f, entry={x}\n'
+         '  %t = f32[2]{0} transpose(p), dimensions={0}, '
+         'metadata={op_name="jit(f)/t" source_file="a.py" source_line=3}\n')
+    b = ('HloModule jit_g, entry={x}\n'
+         '  %t = f32[2]{0} transpose(p), dimensions={0}, '
+         'metadata={op_name="jit(g)/t" source_file="b.py" source_line=9}\n')
+    c = a.replace("f32[2]", "f32[4]")
+    assert hloscan.op_graph_fingerprint(a) == hloscan.op_graph_fingerprint(b)
+    assert hloscan.op_graph_fingerprint(a) != hloscan.op_graph_fingerprint(c)
+
+
+def test_payload_parsing_hlo_and_mlir():
+    hlo = ("  %x = (c64[2,2,9]{2,1,0}, c64[2,2,9]{2,1,0}) "
+           "all-to-all(a, b), replica_groups={}\n"
+           "  %y = bf16[2,4,9]{2,1,0} collective-permute(c)\n")
+    got = hloscan.exchange_payload_bytes("hlo", hlo)
+    assert got["all_to_all"] == [2 * (2 * 2 * 9) * 8]
+    assert got["collective_permute"] == [2 * 4 * 9 * 2]
+    mlir = ('  %0 = "stablehlo.all_to_all"(%arg0) : '
+            "(tensor<2x16x9xcomplex<f32>>) -> tensor<2x16x9xcomplex<f32>>\n")
+    got = hloscan.exchange_payload_bytes("stablehlo", mlir)
+    assert got["all_to_all"] == [2 * 16 * 9 * 8]
+
+
+def test_predicted_payload_ring_discount():
+    assert hloscan.predicted_payload_bytes((8, 4), np.complex64,
+                                           "native") == 8 * 4 * 8
+    assert hloscan.predicted_payload_bytes((8, 4), np.complex64,
+                                           "bf16") == 8 * 4 * 4
+    assert hloscan.predicted_payload_bytes((8, 4), np.complex64, "native",
+                                           ring_size=8) == 8 * 4 * 8 * 7 // 8
+
+
+# ---------------------------------------------------------------------------
+# jaxprlint units (synthetic programs)
+# ---------------------------------------------------------------------------
+
+def test_jaxprlint_unpaired_encode_flags(rng):
+    x = jnp.asarray((rng.random((4, 4)) + 1j * rng.random((4, 4)))
+                    .astype(np.complex64))
+    jaxpr = jax.make_jaxpr(lambda v: wire_encode(v, "bf16"))(x)
+    found = jaxprlint.lint_wire_pairing(jaxpr, expect_crossings=1)
+    lints = {f.lint for f in found}
+    assert "wire-pairing" in lints  # unpaired + bf16 output leak
+
+
+def test_jaxprlint_paired_roundtrip_clean(rng):
+    from distributedfft_tpu.parallel.transpose import wire_decode
+    x = jnp.asarray((rng.random((4, 4)) + 1j * rng.random((4, 4)))
+                    .astype(np.complex64))
+    jaxpr = jax.make_jaxpr(
+        lambda v: wire_decode(wire_encode(v, "bf16"), v.dtype, "bf16"))(x)
+    assert jaxprlint.lint_wire_pairing(jaxpr, expect_crossings=1) == []
+
+
+def test_jaxprlint_native_must_be_inert(rng):
+    x = jnp.asarray(rng.random((4, 4)).astype(np.float32))
+    jaxpr = jax.make_jaxpr(lambda v: v.astype(jnp.bfloat16)
+                           .astype(jnp.float32))(x)
+    found = jaxprlint.lint_wire_pairing(jaxpr, expect_crossings=0)
+    assert found and "structurally inert" in found[0].message
+
+
+def test_jaxprlint_guard_arity(devices):
+    off = _slab(dict(opt=1))
+    on = _slab(dict(opt=1, guards="check"))
+    assert jaxprlint.lint_guard_arity(jaxprlint.plan_jaxpr(off, "forward"),
+                                      "off") == []
+    assert jaxprlint.lint_guard_arity(jaxprlint.plan_jaxpr(on, "forward"),
+                                      "check") == []
+    # Guard ops leaking into an "off" build is the violation.
+    leaked = jaxprlint.lint_guard_arity(
+        jaxprlint.plan_jaxpr(on, "forward"), "off")
+    assert leaked and leaked[0].lint == "guard-off"
+
+
+# ---------------------------------------------------------------------------
+# srclint units (synthetic sources) + the repo is clean
+# ---------------------------------------------------------------------------
+
+def test_srclint_traced_env_read_flagged():
+    src = ("import os\nimport jax\n"
+           "def body(x):\n"
+           "    os.environ.get('K')\n"
+           "    return x\n"
+           "f = jax.jit(body)\n")
+    found = srclint.lint_source(src, "m.py")
+    assert [f.rule for f in found] == ["traced-host-io"]
+    # The allow-comment suppresses it, visibly.
+    src_ok = src.replace("os.environ.get('K')",
+                         "os.environ.get('K')  "
+                         "# srclint: allow(traced-host-io)")
+    assert srclint.lint_source(src_ok, "m.py") == []
+
+
+def test_srclint_decorator_and_attribute_roots():
+    """@jax.jit-decorated defs (the common idiom) and jax.jit(self._body)
+    attribute arguments are traced roots too."""
+    deco = ("import os\nimport jax\n"
+            "@jax.jit\n"
+            "def body(x):\n"
+            "    os.environ.get('K')\n"
+            "    return x\n")
+    assert [f.rule for f in srclint.lint_source(deco, "m.py")] == \
+        ["traced-host-io"]
+    attr = ("import os\nimport jax\n"
+            "class Plan:\n"
+            "    def _body(self, x):\n"
+            "        os.getenv('K')\n"
+            "        return x\n"
+            "    def build(self):\n"
+            "        return jax.jit(self._body)\n")
+    assert [f.rule for f in srclint.lint_source(attr, "m.py")] == \
+        ["traced-host-io"]
+
+
+def test_mlir_tuple_all_to_all_payload_summed():
+    """The StableHLO fallback parser sums tuple-form results like the
+    HLO branch (a tiled all-to-all stages one result per participant)."""
+    line = ('  %0:2 = "stablehlo.all_to_all"(%a, %b) : '
+            "(tensor<2x4xf32>, tensor<2x4xf32>) -> "
+            "(tensor<2x4xf32>, tensor<2x4xf32>)\n")
+    got = hloscan.exchange_payload_bytes("stablehlo", line)
+    assert got["all_to_all"] == [2 * (2 * 4) * 4]
+
+
+def test_srclint_traced_callee_propagates():
+    """A helper called FROM a traced fn is traced too (one-module call
+    graph closure)."""
+    src = ("import os\nimport jax\n"
+           "def helper(x):\n"
+           "    return open('/tmp/f')\n"
+           "def body(x):\n"
+           "    return helper(x)\n"
+           "jax.jit(body)\n")
+    found = srclint.lint_source(src, "m.py")
+    assert any("host I/O call open()" in f.message for f in found)
+
+
+def test_srclint_host_only_jnp():
+    found = srclint.lint_source("from jax import numpy as jnp\n",
+                                "x/obs/tracing.py")
+    assert [f.rule for f in found] == ["host-only-jnp"]
+    # Only the declared host-only modules are constrained.
+    assert srclint.lint_source("import jax.numpy as jnp\n",
+                               "x/models/slab.py") == []
+
+
+def test_srclint_wisdom_flock_detector():
+    unlocked = ("import os\n"
+                "def record(path, data):\n"
+                "    os.replace('tmp', path)\n")
+    found = srclint.lint_source(unlocked, "x/utils/wisdom.py")
+    assert [f.rule for f in found] == ["wisdom-flock"]
+    locked = ("import os\n"
+              "def _advisory_lock(p):\n"
+              "    yield\n"
+              "def record(path, data):\n"
+              "    with _advisory_lock(path):\n"
+              "        os.replace('tmp', path)\n")
+    assert srclint.lint_source(locked, "x/utils/wisdom.py") == []
+
+
+def test_srclint_repo_clean():
+    """The package satisfies its own invariants (the same check the CI
+    verify job runs via dfft-verify)."""
+    findings = srclint.lint_repo()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# explain sources the same registry
+# ---------------------------------------------------------------------------
+
+def test_explain_contract_line(devices, capsys):
+    from distributedfft_tpu.obs import explain
+    rc = explain.main(["--kind", "slab", "-nx", "16", "-ny", "16",
+                       "-nz", "16", "-p", "8", "-o", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "contract: verified (slab/a2a" in out
+
+
+def test_explain_no_compile_contract_unverified(devices, capsys):
+    from distributedfft_tpu.obs import explain
+    rc = explain.main(["--kind", "slab", "-nx", "16", "-ny", "16",
+                       "-nz", "16", "-p", "8", "--no-compile"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "contract: unverified" in out

@@ -136,7 +136,10 @@ def test_async_collective_counts_text_contract():
     must not swallow its async -start form (or vice versa), and
     async_total sums only the starts. ``convert`` counts the wire layer's
     encode/decode casts (tests/test_wire.py asserts the compressed-ring
-    gate on it)."""
+    gate on it). Since the counter moved to ``analysis.hloscan`` (this
+    function delegates) the census also carries the reduction collectives
+    the no-exchange contracts pin; tests/test_analysis.py owns the full
+    text contract."""
     txt = """
   %a = f32[8] all-to-all(x), replica_groups={}
   %b = f32[8] all-to-all-start(x)
@@ -148,4 +151,7 @@ def test_async_collective_counts_text_contract():
     counts = mb.async_collective_counts(txt)
     assert counts == {"all_to_all": 1, "all_to_all_start": 1,
                       "collective_permute": 2, "collective_permute_start": 1,
+                      "all_reduce": 0, "all_reduce_start": 0,
+                      "all_gather": 0, "all_gather_start": 0,
+                      "reduce_scatter": 0, "reduce_scatter_start": 0,
                       "async_total": 2, "convert": 1}

@@ -298,19 +298,23 @@ def test_obs_adds_zero_hlo_ops(tmp_path, devices, cfg_kw, sequence):
     DISABLED for every exchange rendering: spans are host-side intervals,
     never ops, so the disabled path (the default) is transitively pinned
     to the pre-obs programs."""
+    from distributedfft_tpu.analysis import hloscan
+
     g = dfft.GlobalSize(16, 16, 16)
 
     def compile_text():
         plan = dfft.SlabFFTPlan(g, pm.SlabPartition(8),
                                 dfft.Config(**cfg_kw), sequence=sequence)
-        fn = plan._build_r2c()
-        arg = jax.ShapeDtypeStruct(plan.input_padded_shape, np.float32)
-        return fn.lower(arg).compile().as_text()
+        return hloscan.compiled_text(plan, "forward")
 
     obs.disable()
     off = compile_text()
     obs.enable(str(tmp_path / "obs"))
     on = compile_text()
     assert on == off
+    # The metadata-stripped fingerprint (what dfft-verify's pins compare)
+    # agrees by construction.
+    assert hloscan.op_graph_fingerprint(on) == \
+        hloscan.op_graph_fingerprint(off)
     # And the enabled run really did trace (the comparison is not vacuous).
     assert obs.validate_events_dir(str(tmp_path / "obs")) > 0

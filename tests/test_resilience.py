@@ -234,21 +234,24 @@ def test_hlo_byte_identical_when_off(name, kw, seq, devices, monkeypatch):
     the default path carries zero resilience ops. (The cross-commit half
     of the pin — op-graph identity vs the actual pre-PR renderings — was
     verified at development time; this keeps it from regressing.)"""
+    from distributedfft_tpu.analysis import hloscan
+
     def text():
-        plan = _slab(kw, seq)
-        fn = plan._build_r2c()
-        arg = jax.ShapeDtypeStruct(plan.input_padded_shape, np.float32)
-        return fn.lower(arg).compile().as_text()
+        return hloscan.compiled_text(_slab(kw, seq), "forward")
 
     before = text()
     monkeypatch.setenv(inject.ENV_VAR, "wire:bitflip")
     guarded_plan = _slab(kw, seq, guards_mode="check")
-    gfn = guarded_plan._build_r2c()
-    gtxt = gfn.lower(jax.ShapeDtypeStruct(
-        guarded_plan.input_padded_shape, np.float32)).compile().as_text()
+    gtxt = hloscan.compiled_text(guarded_plan, "forward")
     assert gtxt != before  # the guarded+injected build is not vacuous
     monkeypatch.delenv(inject.ENV_VAR)
-    assert text() == before
+    after = text()
+    assert after == before
+    # The metadata-stripped op-graph fingerprint — the byte-identity
+    # currency dfft-verify's pins and the Plan-IR migration net use —
+    # agrees with the full-text comparison.
+    assert hloscan.op_graph_fingerprint(after) == \
+        hloscan.op_graph_fingerprint(before)
 
 
 def test_bitflip_changes_exactly_one_element(devices, monkeypatch):

@@ -33,6 +33,7 @@ from distributedfft_tpu.parallel.transpose import (
     all_to_all_transpose,
     ring_transpose,
 )
+from distributedfft_tpu.analysis import contracts, hloscan
 from distributedfft_tpu.testing.microbench import async_collective_counts
 
 SEQS = ["ZY_Then_X", "Z_Then_YX", "Y_Then_ZX"]
@@ -222,20 +223,20 @@ def test_grad_through_ring_slab_roundtrip(devices, rng):
 # HLO regression counts (the overlap detector as a tier-1 gate)
 # ---------------------------------------------------------------------------
 
-def _lower_fwd(plan, dtype=np.float64):
-    f = plan._build_r2c()
-    return f.lower(jax.ShapeDtypeStruct(plan.input_padded_shape, dtype))
-
-
 def test_hlo_opt1_single_all_to_all(devices):
     """The realigned (opt1) slab forward emits exactly ONE all-to-all (the
     pure exchange) and no collective-permutes — the monolithic rendering's
     signature, so a regression that splits or duplicates the exchange (or
-    re-fuses a ring into it) is caught by count, not by timing drift."""
+    re-fuses a ring into it) is caught by count, not by timing drift.
+    Pinned via the declarative contract (analysis/contracts.py: slab/a2a
+    declares all_to_all == 1, collective_permute == 0 plus the payload
+    reconciliation); the census double-check keeps the count visible
+    here."""
     plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16), pm.SlabPartition(8),
                             dfft.Config(comm_method=pm.CommMethod.ALL2ALL,
                                         opt=1))
-    counts = async_collective_counts(_lower_fwd(plan).compile())
+    assert contracts.verify_plan(plan, "forward") == []
+    counts = async_collective_counts(hloscan.compiled_text(plan, "forward"))
     assert counts["all_to_all"] + counts["all_to_all_start"] == 1
     assert counts["collective_permute"] == 0
     assert counts["collective_permute_start"] == 0
@@ -247,13 +248,18 @@ def test_hlo_ring_p_minus_1_permutes(devices, seq):
     ops and ZERO all-to-alls: the exchange is genuinely split into
     distinct steps XLA cannot re-fuse (the chunked STREAMS reshards WERE
     re-fused — OVERLAP.md), asserted on the 8-device CPU mesh so an
-    overlap regression fails tier-1."""
+    overlap regression fails tier-1. The slab/ring contract declares
+    exactly these rules (>= P-1 permutes, 0 all-to-alls, the (P-1)/P
+    payload discount) — checked for both directions."""
     plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16), pm.SlabPartition(8),
                             RING, sequence=seq)
-    counts = async_collective_counts(_lower_fwd(plan).compile())
-    assert counts["collective_permute"] + \
-        counts["collective_permute_start"] >= 7  # P-1 on the 8-way mesh
-    assert counts["all_to_all"] + counts["all_to_all_start"] == 0
+    contract = contracts.contract_for(plan, "forward")
+    assert any(r.op == "collective_permute" and r.cmp == ">=" and
+               r.value == 7 for r in contract.rules)  # P-1 on the 8-way mesh
+    assert any(r.op == "all_to_all" and r.cmp == "==" and r.value == 0
+               for r in contract.rules)
+    assert contracts.verify_plan(plan, "forward", contract=contract) == []
+    assert contracts.verify_plan(plan, "inverse") == []
 
 
 def test_hlo_ring_pipelines_fft_between_permutes(devices):
@@ -267,8 +273,8 @@ def test_hlo_ring_pipelines_fft_between_permutes(devices):
                             sequence="Z_Then_YX")
     sync = dfft.SlabFFTPlan(g, pm.SlabPartition(8), dfft.Config(),
                             sequence="Z_Then_YX")
-    ring_txt = _lower_fwd(ring).as_text()
-    sync_txt = _lower_fwd(sync).as_text()
+    ring_txt = hloscan.lower_plan(ring, "forward").as_text()
+    sync_txt = hloscan.lower_plan(sync, "forward").as_text()
     n_ring = len(re.findall(r"\.fft", ring_txt))  # stablehlo.fft / mhlo.fft
     n_sync = len(re.findall(r"\.fft", sync_txt))
     assert len(re.findall(r"collective_permute", ring_txt)) >= 7
@@ -277,16 +283,14 @@ def test_hlo_ring_pipelines_fft_between_permutes(devices):
 
 def test_hlo_pencil_ring_both_transposes(devices):
     """Pencil ring at dims=3: transpose 1 rings over p2 (3 permutes on a
-    2x4 grid), transpose 2 over p1 (1 permute) — both all-to-alls gone."""
+    2x4 grid), transpose 2 over p1 (1 permute) — both all-to-alls gone.
+    The pencil/ring contract sums the per-transpose ring steps."""
     plan = dfft.PencilFFTPlan(dfft.GlobalSize(16, 16, 16),
                               pm.PencilPartition(2, 4), RING)
-    counts = async_collective_counts(
-        plan._build_r2c_d(3).lower(
-            jax.ShapeDtypeStruct(plan.input_padded_shape,
-                                 np.float64)).compile())
-    assert counts["collective_permute"] + \
-        counts["collective_permute_start"] >= 4
-    assert counts["all_to_all"] + counts["all_to_all_start"] == 0
+    contract = contracts.contract_for(plan, "forward")
+    assert any(r.op == "collective_permute" and r.cmp == ">=" and
+               r.value == 4 for r in contract.rules)  # (p2-1) + (p1-1)
+    assert contracts.verify_plan(plan, "forward", contract=contract) == []
 
 
 # ---------------------------------------------------------------------------

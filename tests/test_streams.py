@@ -159,6 +159,26 @@ def test_batched2d_streams_matches_sync(devices, rng, comm):
     np.testing.assert_allclose(y, x * m * m, rtol=1e-10, atol=1e-10)
 
 
+def test_streams_hlo_contract(devices):
+    """The chunked rendering's structural signature, via the declarative
+    contract (analysis/contracts.py): under ALL2ALL the exchange stages
+    exactly K all-to-alls (one per piece chain); under PEER2PEER GSPMD
+    re-fuses the piece reshards, so the p2p contract (lower bounds only)
+    applies — the honest no-op, OVERLAP.md."""
+    from distributedfft_tpu.analysis import contracts
+
+    g = GlobalSize(16, 16, 16)
+    st = SlabFFTPlan(g, SlabPartition(8), _cfg(CommMethod.ALL2ALL, 3))
+    contract = contracts.contract_for(st, "forward")
+    assert contract.name == "slab/streams"
+    assert any(r.op == "all_to_all" and r.cmp == "==" and r.value == 3
+               for r in contract.rules)
+    assert contracts.verify_plan(st, "forward", contract=contract) == []
+    fused = SlabFFTPlan(g, SlabPartition(8), _cfg(CommMethod.PEER2PEER, 3))
+    assert contracts.contract_for(fused, "forward").name == "slab/p2p"
+    assert contracts.verify_plan(fused, "forward") == []
+
+
 def test_overlap_race_contract(devices):
     """overlap_race: per-piece collective counts scale with the chunk count,
     the ring variant races alongside with its P-1 permutes per transpose,

@@ -47,6 +47,7 @@ from distributedfft_tpu.parallel.transpose import (
     wire_encode,
     wire_nbytes,
 )
+from distributedfft_tpu.analysis import contracts, hloscan, jaxprlint
 from distributedfft_tpu.testing.microbench import async_collective_counts
 from distributedfft_tpu.utils import wisdom
 from distributedfft_tpu.utils.timer import benchmark_filename
@@ -178,14 +179,21 @@ def test_pencil_native_wire_bit_identical(devices, rng, dims, rendering):
 
 @pytest.mark.parametrize("rendering", sorted(RENDERINGS))
 def test_native_wire_hlo_carries_no_bf16(devices, rendering):
-    """Structural pin of bit-identity: a native-wire plan's lowered HLO
+    """Structural pin of bit-identity: a native-wire plan's program
     contains no bf16 anywhere — the wire layer is inert, not merely
-    numerically invisible."""
+    numerically invisible. Pinned three ways through the analysis
+    subsystem: the contract's forbidden-op rule on the COMPILED module,
+    a direct scan of the STAGED module, and the jaxpr lint (zero bf16
+    conversions traced)."""
     plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
                             pm.SlabPartition(8), _cfg(rendering, "native"))
-    txt = plan._build_r2c().lower(
-        jax.ShapeDtypeStruct(plan.input_padded_shape, np.float64)).as_text()
-    assert "bf16" not in txt
+    contract = contracts.contract_for(plan, "forward")
+    assert any(r.kind == "forbid" and r.op == "bf16"
+               for r in contract.rules)
+    assert contracts.verify_plan(plan, "forward", contract=contract) == []
+    assert not hloscan.contains_bf16(hloscan.staged_text(plan,
+                                                         "forward")[1])
+    assert jaxprlint.lint_plan(plan, "forward") == []
 
 
 def test_batched2d_native_wire_bit_identical(devices, rng):
@@ -502,8 +510,11 @@ def test_hlo_bf16_ring_keeps_p_minus_1_permutes(devices):
     plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
                             pm.SlabPartition(8), _cfg("ring", "bf16"),
                             sequence="Z_Then_YX")
-    counts = async_collective_counts(plan._build_r2c().lower(
-        jax.ShapeDtypeStruct(plan.input_padded_shape, np.float32)).compile())
+    # The slab/ring contract (>= P-1 permutes, 0 all-to-alls, halved
+    # payload) holds under compression; the convert count attributes the
+    # wire casts.
+    assert contracts.verify_plan(plan, "forward") == []
+    counts = async_collective_counts(hloscan.compiled_text(plan, "forward"))
     assert counts["collective_permute"] + \
         counts["collective_permute_start"] >= 7  # P-1 on the 8-way mesh
     assert counts["all_to_all"] + counts["all_to_all_start"] == 0
@@ -516,11 +527,11 @@ def test_hlo_bf16_opt1_still_single_all_to_all(devices):
     now over the bf16 planes."""
     plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
                             pm.SlabPartition(8), _cfg("opt1", "bf16"))
-    compiled = plan._build_r2c().lower(
-        jax.ShapeDtypeStruct(plan.input_padded_shape, np.float32)).compile()
-    counts = async_collective_counts(compiled)
+    assert contracts.verify_plan(plan, "forward") == []
+    txt = hloscan.compiled_text(plan, "forward")
+    counts = async_collective_counts(txt)
     assert counts["all_to_all"] + counts["all_to_all_start"] == 1
-    assert "bf16" in compiled.as_text()
+    assert hloscan.contains_bf16(txt)
 
 
 # ---------------------------------------------------------------------------

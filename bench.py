@@ -29,6 +29,13 @@ eating the whole 480 s deadline with nothing emitted; see
   outwait more than ~3 min of a 10-15 min wedge (VERDICT r2 missing#2).
   Only if it exits cleanly does the real measurement run; a clean fast
   failure earns one immediate re-probe, a killed probe does not.
+* Child 2b (``--child serve``) is the serving-layer saturation bench
+  (ISSUE 8): cold per-invocation plan-build+execute vs warm plan-cache
+  p50 for a repeated shape, then an open-loop offered-load sweep
+  (``testing/workloads.serve_load``: Poisson arrivals against the
+  in-process ``serve.Server``) reporting p50/p99 latency, sustained
+  FFTs/sec, shed counts and the plan-cache hit rate per rate. CPU-only
+  like the mesh child, so it is tunnel-immune and strictly bounded.
 * Child 3 (``--child tpu``) times the single-chip R2C+C2R roundtrip at
   128^3 and 256^3 with the shared chained-roundtrip harness
   (distributedfft_tpu/testing/chaintimer.py: scalar-fenced jitted fori_loop
@@ -65,6 +72,7 @@ BASELINE_ROUNDTRIP_MS = 4.4  # 2 x 2.20 ms (argon single-GPU 256^3 inverse)
 BUDGET_S = 450               # parent wall-clock; driver's outer limit is >480
 PROBE_TIMEOUT_S = 180        # re-probe ceiling (first probe rides the budget)
 MESH_TIMEOUT_S = 300
+SERVE_TIMEOUT_S = 90         # serving-layer saturation bench (CPU, bounded)
 MEASURE_RESERVE_S = 120      # budget step 3 needs after a successful probe
 # Default sweep covers the BASELINE metric's own sizes (VERDICT r3 item 7:
 # the artifact must re-measure them, not rely on committed CSVs). Headline
@@ -819,6 +827,98 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
     return 0
 
 
+def _child_serve(deadline_s: int = 90) -> int:
+    """Serving-layer saturation bench (ISSUE 8; CPU mesh, tunnel-immune):
+    cold per-invocation plan-build+execute vs warm plan-cache p50 for a
+    repeated shape, then an open-loop offered-load sweep (Poisson
+    arrivals via ``testing/workloads.serve_load``) reporting p50/p99
+    latency, sustained FFTs/sec, shed counts and the plan-cache hit rate
+    at each rate — the steady-state workload every later perf PR is
+    measured against (ROADMAP item 2)."""
+    from distributedfft_tpu.parallel.mesh import force_cpu_devices
+    force_cpu_devices(8)
+
+    import numpy as np
+
+    from distributedfft_tpu.serve import Server
+    from distributedfft_tpu.testing.workloads import serve_load
+
+    out = {}
+
+    def _handler(signum, frame):
+        raise TimeoutError("serve child deadline")
+    signal.signal(signal.SIGALRM, _handler)
+    signal.alarm(max(20, deadline_s - 10))
+    try:
+        n = int(os.environ.get("DFFT_BENCH_SERVE_N", "128"))
+        shape = (n, n)
+        rng = np.random.default_rng(0)
+
+        # Cold per-invocation baseline: what every CLI run pays today —
+        # a fresh plan (trace + compile) per request. Each sample uses a
+        # FRESH plan object, so jit caching cannot hide the build.
+        from distributedfft_tpu import Config, SlabPartition
+        from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+        cold_ms = []
+        for i in range(3):
+            x = rng.random(shape, dtype=np.float32)
+            t0 = time.perf_counter()
+            plan = Batched2DFFTPlan(1, n, n, SlabPartition(1), Config(),
+                                    batch_chunk=1)
+            np.asarray(plan.exec_forward(x[None]))
+            cold_ms.append((time.perf_counter() - t0) * 1e3)
+        out["cold_per_invocation_ms"] = round(sorted(cold_ms)[1], 3)
+
+        # Warm plan-cache path: one server, repeated same-shape requests.
+        with Server(latency_budget_ms=10_000) as srv:
+            srv.request(rng.random(shape, dtype=np.float32))  # build once
+            warm = []
+            for i in range(30):
+                x = rng.random(shape, dtype=np.float32)
+                t0 = time.perf_counter()
+                srv.request(x)
+                warm.append((time.perf_counter() - t0) * 1e3)
+            warm = np.asarray(warm)
+            out["warm_p50_ms"] = round(float(np.percentile(warm, 50)), 3)
+            out["warm_p99_ms"] = round(float(np.percentile(warm, 99)), 3)
+            out["warm_speedup_vs_cold"] = round(
+                out["cold_per_invocation_ms"] / out["warm_p50_ms"], 1)
+
+        # Offered-load sweep: open loop, fresh server per rate so each
+        # row's queue/EMA state is independent. The top rate is sized to
+        # exceed the warm capacity so shedding is exercised, not assumed.
+        warm_rate = 1e3 / max(out["warm_p50_ms"], 1e-3)
+        rates = sorted({round(r, 1) for r in (
+            warm_rate * 0.25, warm_rate * 0.5, warm_rate,
+            warm_rate * 2.0)})
+        rows = []
+        for rate in rates:
+            with Server(latency_budget_ms=250.0, max_queue=64) as srv:
+                r = serve_load(srv, rate_hz=rate, duration_s=2.5,
+                               shapes=(shape,), seed=1, warmup=2)
+                snap = srv.health()["plan_cache"]
+                r["plan_cache_hit_rate"] = snap["hit_rate"]
+                r["shed"] = r["outcomes"]["shed"]
+                rows.append(r)
+        out["offered_load_sweep"] = rows
+        out["shape"] = list(shape)
+        out["note"] = ("open-loop Poisson arrivals (serve_load) against "
+                       "dfft-serve's in-process Server on the CPU backend; "
+                       "latency_budget_ms=250, max_coalesce=8, "
+                       "batch_chunk=1; warm-cache p50 must beat "
+                       "cold_per_invocation_ms (plan-build+execute)")
+    except TimeoutError as e:
+        out["partial"] = True
+        out["error"] = str(e)
+    except Exception as e:  # noqa: BLE001 — still print what was measured
+        out["partial"] = True
+        out["error"] = f"{type(e).__name__}: {e}"
+    _fold_obs_metrics(out)
+    signal.alarm(0)
+    print(json.dumps(out))
+    return 0
+
+
 def _direct_plan_override(backend: str, n: int):
     """(MXUSettings, artifact note) for sizes where the ALL-DIRECT matmul
     plan is the measured winner; (None, None) otherwise.
@@ -1033,6 +1133,21 @@ def main() -> int:
     if d:
         diags.append(d)
 
+    # 2b. Serving-layer saturation bench (ISSUE 8): CPU-only like the mesh
+    #     child (tunnel-immune), short and bounded — the probe keeps
+    #     waiting underneath it, so its cost to the TPU path is just the
+    #     wall clock it occupies above the measurement reserve.
+    serve = None
+    serve_grant = min(SERVE_TIMEOUT_S, remaining() - MEASURE_RESERVE_S)
+    if serve_grant >= 30:
+        serve, d = _run_child("serve", serve_grant,
+                              extra=(int(serve_grant),))
+        if d:
+            diags.append(d)
+    else:
+        diags.append("serve: skipped, no budget above the measurement "
+                     "reserve")
+
     # Collect the probe with everything left above the measurement
     # reserve (it has already been waiting the whole mesh phase).
     tpu = None
@@ -1216,6 +1331,11 @@ def main() -> int:
             # Obs registry snapshot of the mesh child (wisdom hits/misses,
             # race cells, per-shard wire bytes, HLO census gauges).
             result["obs_metrics_mesh"] = mesh["obs_metrics"]
+    if serve:
+        # Serving-layer saturation record (ISSUE 8): cold vs warm-cache
+        # latency and the offered-load sweep (p50/p99, FFTs/sec, shed,
+        # plan-cache hit rate) — ROADMAP item 2's decision measurement.
+        result["serve"] = serve
     if (tpu or {}).get("obs_metrics"):
         result["obs_metrics_tpu"] = tpu["obs_metrics"]
     if (tpu or {}).get("partial"):
@@ -1280,6 +1400,9 @@ if __name__ == "__main__":
         if name == "tpu":
             sys.exit(_child_tpu(int(sys.argv[3]) if len(sys.argv) > 3
                                 else 300))
+        if name == "serve":
+            sys.exit(_child_serve(int(sys.argv[3]) if len(sys.argv) > 3
+                                  else SERVE_TIMEOUT_S))
         print(f"unknown child {name}", file=sys.stderr)
         sys.exit(2)
     try:

@@ -219,14 +219,44 @@ def _resilience_lines(plan, cfg, prov) -> list:
         for slot in ("comm", "wire"):
             rec = store.lookup(prov["key"], slot)
             if rec and rec.get("demoted"):
+                in_force = wisdom.demotion_active(rec)
+                verdict = ("record reads as a miss; next race re-records"
+                           if in_force else
+                           "EXPIRED ($DFFT_DEMOTION_TTL_S) — record "
+                           "re-admitted, stamp kept as history")
                 stamps.append(
                     f"  demotion stamp [{slot}]: rung "
                     f"{rec.get('demoted_rung')} at "
                     f"{rec.get('demoted_at', '?')} — "
-                    f"{rec.get('demoted_reason', '')[:80]} (record reads "
-                    "as a miss; next race re-records)")
+                    f"{rec.get('demoted_reason', '')[:80]} ({verdict})")
     lines += stamps if stamps else ["  demotion stamps: none"]
     return lines
+
+
+def _serve_lines(args, kind: str, plan, cfg) -> list:
+    """The ``serve:`` section: how a 2D request of this plane shape would
+    be served by ``dfft-serve`` — the plan-cache key it would occupy,
+    coalescing eligibility, and the circuit/ladder policy that would wrap
+    it. Static (reuses the resolved plan/config; nothing executes)."""
+    from .. import serve
+    if kind == "batched":
+        nx, ny = args.input_dim_x, args.input_dim_y
+        shard = args.shard
+        transform = plan.transform
+        lead = []
+    else:
+        # The serving layer's unit of traffic is a single 2D image; for a
+        # 3D plan, explain the (nx x ny) front-plane request a client
+        # WOULD send (3D volumes go through the CLI/batch path).
+        nx, ny = args.input_dim_x, args.input_dim_y
+        shard = "batch"
+        transform = "c2c" if args.c2c else "r2c"
+        lead = ["  (dfft-serve serves single 2D images; this 3D plan runs "
+                "through the CLI/batch path — below: the nx x ny 2D "
+                "request a client would send)"]
+    return lead + serve.describe_request(
+        nx, ny, double=cfg.double_prec, transform=transform, shard=shard,
+        config=cfg)
 
 
 def _roofline_lines(args, kind: str, backend: str) -> list:
@@ -490,6 +520,9 @@ def main(argv=None) -> int:
 
         out.append("resilience:")
         out.extend(_resilience_lines(plan, cfg, prov))
+
+        out.append("serve:")
+        out.extend(_serve_lines(args, kind, plan, cfg))
 
         if not args.no_compile:
             out.append("hlo census (forward program, compiled, "

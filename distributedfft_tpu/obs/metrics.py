@@ -46,6 +46,27 @@ inject.wire_faults         counter wire faults injected into traced programs
 inject.coordinator_failures counter simulated coordinator connect failures
 inject.lock_contentions    counter simulated held-lock reads
 inject.cell_hangs          counter simulated hung race cells
+inject.server_slow         counter injected serve-path straggler delays
+wisdom.demotion_expired    counter demotion stamps aged out (TTL) on read
+serve.requests             counter requests admitted to the queue
+serve.requests_served      counter requests answered with a result
+serve.batches              counter coalesced batch executions
+serve.batch_failures       counter batch executions that raised
+serve.coalesced_requests   counter requests served in batches of size > 1
+serve.shed                 counter admissions rejected Overloaded
+serve.rejected_closed      counter admissions rejected while draining
+serve.deadline_expired     counter requests expired before/after execution
+serve.circuit.opened       counter circuits tripped open (closed -> open)
+serve.circuit.reopened     counter half-open probes that failed
+serve.circuit.half_open    counter cooldown expiries admitting a probe
+serve.circuit.closed       counter probes that closed a circuit
+serve.circuit.rejected     counter requests rejected on an open circuit
+serve.plan_cache.hits      counter plan-cache hits (zero recompiles)
+serve.plan_cache.misses    counter plan-cache misses (plan built)
+serve.plan_cache.evictions counter LRU evictions
+serve.plan_cache.size      gauge   live plan-cache occupancy
+serve.queue_depth          gauge   admission queue depth after last change
+serve.ema_ms               gauge   per-request execution EMA (warm batches)
 ========================== ======= ==========================================
 
 Counters accumulate until ``reset()`` (tests reset between plans); gauges

@@ -17,17 +17,31 @@ HLO byte-identical with ``guards="off"`` and ``$DFFT_FAULT_SPEC`` unset:
   demand: it pulls in the testcase harness, which this package root must
   not).
 
+The serving layer (ISSUE 8) added two more host-side legs, both usable
+standalone:
+
+* ``deadline`` — cooperative per-request deadlines with thread-local
+  scope propagation (``fallback.execute`` bounds its ladder walk by the
+  ambient deadline).
+* ``circuit``  — a per-key circuit breaker (closed -> open on K
+  consecutive failures -> half-open probe -> close), the serving layer's
+  fast-rejection wrapper AROUND the fallback ladder.
+
 Host-side retry/timeout/backoff (wisdom lock breaking, coordinator
 connect backoff, autotune cell timeouts) lives with the machinery it
 protects (``utils/wisdom.py``, ``parallel/multihost.py``,
 ``testing/autotune.py``) and reports through the same ``obs`` metrics.
 """
 
-from . import fallback, guards, inject
+from . import circuit, deadline, fallback, guards, inject
+from .circuit import CircuitBreaker, CircuitOpen
+from .deadline import Deadline, DeadlineExceeded
 from .guards import GuardViolation, parseval_tolerance
-from .inject import FaultSpec, parse_fault_spec
+from .inject import FaultSpec, parse_fault_spec, parse_fault_specs
 
 __all__ = [
-    "FaultSpec", "GuardViolation", "fallback", "guards", "inject",
-    "parse_fault_spec", "parseval_tolerance",
+    "CircuitBreaker", "CircuitOpen", "Deadline", "DeadlineExceeded",
+    "FaultSpec", "GuardViolation", "circuit", "deadline", "fallback",
+    "guards", "inject", "parse_fault_spec", "parse_fault_specs",
+    "parseval_tolerance",
 ]

@@ -215,16 +215,24 @@ def execute(plan, direction: str, x, get_runner, dims: int = 3):
 
     ``GuardViolation`` (enforce mode) is never retried — the guard's
     verdict IS the answer. A default-rendering plan has zero rungs, so its
-    errors propagate exactly as they did before this layer existed."""
-    deadline = time.monotonic() + float(
-        os.environ.get("DFFT_FALLBACK_DEADLINE_S", "600"))
+    errors propagate exactly as they did before this layer existed.
+
+    Deadline plumbing (serving layer): when an ambient cooperative
+    deadline is open (``resilience.deadline.scope``), the ladder walk is
+    bounded by the TIGHTER of it and ``DFFT_FALLBACK_DEADLINE_S`` — a
+    retry on behalf of a served request must stop when the request's
+    budget is gone, and the original error (not a timeout) propagates."""
+    from . import deadline as _dl
+    horizon = time.monotonic() + min(
+        float(os.environ.get("DFFT_FALLBACK_DEADLINE_S", "600")),
+        _dl.remaining_s(float("inf")))
     while True:
         try:
             out = get_runner()(x)
         except guards.GuardViolation:
             raise
         except Exception as err:  # noqa: BLE001 — the ladder's contract
-            if time.monotonic() > deadline or not demote(plan, err):
+            if time.monotonic() > horizon or not demote(plan, err):
                 raise
             continue
         return guards.finish(plan, out, direction, dims)

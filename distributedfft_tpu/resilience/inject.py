@@ -10,19 +10,28 @@ unchanged and adds ZERO ops to any traced program (compiled HLO is
 byte-identical to the pre-injection programs, pinned by
 ``tests/test_resilience.py``).
 
-Fault-spec grammar (one fault per process)::
+Fault-spec grammar (one fault per spec; comma-separate to run several
+fault CLASSES concurrently — the serve chaos drill injects
+``wire:bitflip,server:slow:40`` so wire corruption and stragglers hit the
+same live server)::
 
-    kind:mode[:param][@seed=N]
+    kind:mode[:param][@seed=N][,kind:mode...]
 
     wire:nan                 # one payload element of every exchange -> NaN
     wire:bitflip             # XOR the top exponent bit of one element
     wire:scale[:F]           # scale the whole exchange payload by F (0.5)
+    server:slow[:MS]         # host-side straggler: sleep MS milliseconds
+                             # (50 default) inside the serve execution path
+                             # (exercises deadline expiry + load shedding)
     coordinator:down[:K]     # coordinator connect fails (first K attempts;
                              # no K = every attempt)
     wisdom:stale-lock        # the wisdom advisory flock reads as held by a
                              # hung process (exercises stale-break/timeout)
     autotune:hang[:S]        # every autotune race cell sleeps S seconds
                              # (3600 default) before measuring
+
+At most one fault per KIND — duplicates are rejected at parse (two wire
+faults in one process would make the corrupted image ambiguous).
 
 ``seed`` (default 0) keys the corrupted element index, so a chaos run is
 reproducible bit-for-bit. The wire injectors corrupt the payload at the
@@ -50,6 +59,7 @@ ENV_VAR = "DFFT_FAULT_SPEC"
 _WIRE_MODES = ("nan", "bitflip", "scale")
 _KINDS = {
     "wire": _WIRE_MODES,
+    "server": ("slow",),
     "coordinator": ("down",),
     "wisdom": ("stale-lock",),
     "autotune": ("hang",),
@@ -102,19 +112,45 @@ def parse_fault_spec(s: str) -> FaultSpec:
     return FaultSpec(kind, mode, param, seed)
 
 
-def active() -> Optional[FaultSpec]:
-    """The process's fault spec, or None. Read from the environment on
-    every call (trace-time for the wire hooks), so a test can flip faults
-    on/off between plan builds without touching module state."""
+def parse_fault_specs(s: str) -> tuple:
+    """Parse a (possibly comma-separated) multi-fault spec into a tuple of
+    :class:`FaultSpec`, strictly: every element must parse, an empty
+    element (``wire:nan,,``) is malformed. At most one spec per KIND —
+    duplicates would make the injected image ambiguous."""
+    parts = [p.strip() for p in str(s).split(",")]
+    if not all(parts):
+        raise ValueError(f"empty element in multi-fault spec {s!r}")
+    specs = tuple(parse_fault_spec(p) for p in parts)
+    kinds = [sp.kind for sp in specs]
+    if len(set(kinds)) != len(kinds):
+        raise ValueError(f"duplicate fault kind in {s!r} "
+                         "(at most one fault per kind)")
+    return specs
+
+
+def active_specs() -> tuple:
+    """Every active fault spec (empty tuple when ``$DFFT_FAULT_SPEC`` is
+    unset). Read from the environment on every call (trace-time for the
+    wire hooks), so a test can flip faults on/off between plan builds
+    without touching module state."""
     raw = os.environ.get(ENV_VAR, "").strip()
     if not raw:
-        return None
-    return parse_fault_spec(raw)
+        return ()
+    return parse_fault_specs(raw)
+
+
+def active() -> Optional[FaultSpec]:
+    """The process's first fault spec, or None (legacy single-fault
+    accessor; prefer :func:`active_specs`)."""
+    specs = active_specs()
+    return specs[0] if specs else None
 
 
 def _spec_of(kind: str) -> Optional[FaultSpec]:
-    spec = active()
-    return spec if spec is not None and spec.kind == kind else None
+    for spec in active_specs():
+        if spec.kind == kind:
+            return spec
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -202,6 +238,21 @@ def lock_contended() -> bool:
         return False
     obs.metrics.inc("inject.lock_contentions")
     return True
+
+
+def maybe_slow_server(where: str) -> None:
+    """Simulate a host-side straggler in the serving execution path
+    (``server:slow[:MS]``, default 50 ms): sleep before the batch
+    executes, so queued requests age — the chaos harness's lever for
+    deadline expiry and load shedding. Host-side only (zero traced ops;
+    the compiled programs are untouched)."""
+    spec = _spec_of("server")
+    if spec is None:
+        return
+    delay_ms = 50.0 if spec.param is None else float(spec.param)
+    obs.metrics.inc("inject.server_slow")
+    obs.event("inject.server_slow", where=where, ms=delay_ms)
+    time.sleep(delay_ms / 1e3)
 
 
 def maybe_hang_cell(label: str) -> None:

@@ -1,0 +1,89 @@
+"""FFT-as-a-service: the hardened long-lived serving layer (ISSUE 8).
+
+The reference's L6 launcher (``launch.py`` + JSON job specs) is a
+batch-era surface: build a plan, run a job, exit. The million-user north
+star (ROADMAP item 2) needs its interactive-traffic successor — one
+resident process that keeps compiled plans hot and survives real traffic
+and real faults. Three pieces:
+
+* ``plancache`` — bounded LRU of live plans, keyed like wisdom plus the
+  coalescing batch bucket; a cache hit performs zero recompiles.
+* ``server``   — :class:`Server`: deadline-aware admission control with
+  load shedding (structured :class:`Overloaded`, never unbounded
+  latency), same-shape request coalescing into ``batched2d`` stacked
+  execution, a per-key circuit breaker around the PR 5 fallback ladder,
+  a health/readiness snapshot over the PR 4 metrics registry, and
+  graceful drain.
+* ``cli``      — the ``dfft-serve`` executable: ``--drive`` runs the
+  open-loop load generator (``testing/workloads.serve_load``) against an
+  in-process server (the chaos-CI and saturation-bench surface);
+  ``--http`` serves ``/healthz`` / ``/readyz`` / ``POST /fft`` over
+  stdlib HTTP.
+
+The chaos contract: under ``$DFFT_FAULT_SPEC`` wire faults and
+``server:slow`` stragglers a live server must never hang or crash —
+circuits open, load sheds, deadlines expire, and every transition leaves
+``serve.*`` evidence in the obs event log (CI's serve chaos job asserts
+exactly that).
+"""
+
+from . import plancache
+from .plancache import PlanCache, bucket_for, cache_key, request_key
+from .server import Overloaded, Server, ServerClosed
+
+__all__ = [
+    "Overloaded", "PlanCache", "Server", "ServerClosed", "bucket_for",
+    "cache_key", "describe_request", "plancache", "request_key",
+]
+
+
+def describe_request(nx: int, ny: int, *, double: bool = False,
+                     transform: str = "r2c", shard: str = "batch",
+                     config=None, circuit_k: int = 3,
+                     circuit_cooldown_s: float = 5.0,
+                     max_coalesce: int = 8) -> list:
+    """The ``dfft-explain`` ``serve:`` section: for one request shape,
+    the plan-cache key it would occupy, its coalescing eligibility, and
+    the circuit/ladder policy that would wrap its execution — all static
+    (nothing is built or executed), reusing the same key and ladder
+    machinery the live server uses."""
+    from ..resilience import fallback
+    from ..utils.wisdom import _describe_comm
+    code = "f64" if double else "f32"
+    base = request_key(nx, ny, code, transform, shard)
+    buckets = []
+    top = bucket_for(max_coalesce, max_coalesce)
+    b = 1
+    while b <= top:
+        buckets.append(str(b))
+        b <<= 1
+    lines = [
+        f"  request key: {base}",
+        f"  plan cache slots: {base}#b{{{','.join(buckets)}}} "
+        "(LRU, power-of-two coalescing buckets)",
+    ]
+    if shard == "batch":
+        lines.append(
+            f"  coalescing: eligible — same-key requests stack along the "
+            f"batch axis (up to {max_coalesce}; batch_chunk=1 per-plane "
+            "rendering, bit-identical to single-shot)")
+    else:
+        lines.append(
+            f"  coalescing: eligible — stacked along the untouched batch "
+            f"axis of the shard='x' slab pipeline (up to {max_coalesce}; "
+            "whole-stack fused, exchanges per batch)")
+    lines.append(
+        f"  circuit: {circuit_k} consecutive failures open; half-open "
+        f"probe after {circuit_cooldown_s:g} s (plan cache invalidated on "
+        "open, so the probe rebuilds)")
+    if config is not None:
+        ladder = fallback.ladder_preview(config)
+        if ladder:
+            steps = " -> ".join(f"[{r}] {lbl}" for r, lbl in ladder)
+            lines.append(f"  inside the circuit: fallback ladder {steps} "
+                         "-> failure counts toward the breaker")
+        else:
+            lines.append("  inside the circuit: default rendering, no "
+                         "ladder — each failure counts toward the breaker")
+        lines.append(f"  served config: {_describe_comm(config)}")
+    return lines

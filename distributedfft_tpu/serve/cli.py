@@ -1,0 +1,319 @@
+"""``dfft-serve`` — the long-lived FFT server as an executable.
+
+Two complementary surfaces over one in-process :class:`Server`:
+
+* ``--drive`` runs the open-loop load generator
+  (``testing/workloads.serve_load``: Poisson arrivals, mixed
+  shape/dtype traffic) against the server and prints ONE final JSON
+  summary line — the surface the chaos CI job and the saturation bench
+  drive. ``--health-out`` additionally writes the final health snapshot
+  (the readiness document CI asserts ``degraded`` on when a fault opened
+  a circuit).
+* ``--http PORT`` serves the request/health API over stdlib HTTP (no new
+  dependencies): ``GET /healthz`` returns the health snapshot JSON,
+  ``GET /readyz`` answers 200 only while the server admits work (503
+  when draining/stopped — the load-balancer contract), and
+  ``POST /fft`` executes one request: body is an ``.npy`` payload,
+  headers ``X-DFFT-Transform`` (r2c|c2c), ``X-DFFT-Direction``
+  (forward|inverse), ``X-DFFT-Ny`` (inverse r2c logical width) and
+  ``X-DFFT-Deadline-Ms`` select the work; rejections map to structured
+  status codes (429 Overloaded, 503 circuit open / closed, 504 deadline
+  exceeded).
+
+SIGTERM/SIGINT trigger a GRACEFUL DRAIN: in-flight and queued work
+finishes, new admissions are rejected with ``ServerClosed``, wisdom and
+the obs event log are already flushed (atomic replace / per-line
+append), and the process exits 0 — the contract a rolling restart needs.
+
+Examples::
+
+    dfft-serve --drive --rate 50 --duration 10 --shapes 256x256,128x128 \
+        --deadline-ms 500 --emulate-devices 8
+    dfft-serve --http 8080 --emulate-devices 8   # curl :8080/healthz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="dfft-serve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--partitions", "-p", type=int, default=1,
+                    help="mesh width the served plans decompose over "
+                         "(default 1 = single device)")
+    ap.add_argument("--shard", default="batch", choices=("batch", "x"),
+                    help="batched2d decomposition of served plans: "
+                         "'batch' (embarrassingly parallel, default) or "
+                         "'x' (slab-style with a real exchange — the "
+                         "decomposition chaos drills target)")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="admission queue bound (beyond it: Overloaded)")
+    ap.add_argument("--latency-budget-ms", type=float, default=1000.0,
+                    help="shed when estimated queue delay exceeds this")
+    ap.add_argument("--max-coalesce", type=int, default=8,
+                    help="max same-shape requests stacked into one "
+                         "batched execution")
+    ap.add_argument("--batch-chunk", type=int, default=1,
+                    help="batched2d batch_chunk of served plans "
+                         "(shard=batch only; 0 = whole stack fused)")
+    ap.add_argument("--cache-capacity", type=int, default=8,
+                    help="LRU plan cache slots")
+    ap.add_argument("--circuit-k", type=int, default=3,
+                    help="consecutive failures that open a plan key's "
+                         "circuit")
+    ap.add_argument("--circuit-cooldown-s", type=float, default=5.0,
+                    help="open-circuit cooldown before the half-open probe")
+    ap.add_argument("--guards", default=None,
+                    choices=("off", "check", "enforce"),
+                    help="in-graph numerical guards of served plans "
+                         "(default $DFFT_GUARDS -> off)")
+    ap.add_argument("--wire-dtype", "-wire", default="native",
+                    choices=("native", "bf16"),
+                    help="wire encoding of served plans' exchanges "
+                         "(shard=x; no 'auto' — a serving process must "
+                         "not race)")
+    ap.add_argument("--comm-method", "-comm", default="All2All",
+                    help="comm method of served plans (shard=x)")
+    ap.add_argument("--opt", "-o", type=int, default=0, choices=(0, 1))
+    ap.add_argument("--fft-backend", default="xla")
+    ap.add_argument("--wisdom", default=None, metavar="PATH")
+    ap.add_argument("--no-wisdom", action="store_true")
+    ap.add_argument("--emulate-devices", type=int,
+                    default=int(os.environ.get("DFFT_EMULATE_DEVICES", "0")))
+    ap.add_argument("--obs", action="store_true",
+                    help="print obs notices + the metrics snapshot")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="write the structured JSONL event log here "
+                         "(same as $DFFT_OBS_DIR)")
+    ap.add_argument("--http", type=int, default=0, metavar="PORT",
+                    help="serve GET /healthz, GET /readyz and POST /fft "
+                         "on this port (0 = off)")
+    ap.add_argument("--health-out", default=None, metavar="PATH",
+                    help="write the final health snapshot JSON here on "
+                         "exit (the CI assertion surface)")
+    # --drive: the open-loop load generator
+    ap.add_argument("--drive", action="store_true",
+                    help="drive the built-in open-loop load generator "
+                         "against this server, print a JSON summary, "
+                         "drain and exit (chaos-CI / bench surface)")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="offered load, requests/sec (Poisson arrivals)")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="drive window, seconds")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="drive a fixed request count instead of "
+                         "--duration")
+    ap.add_argument("--shapes", default="256x256",
+                    help="comma-separated NXxNY request shapes the "
+                         "traffic mixes over")
+    ap.add_argument("--dtypes", default="f32",
+                    help="comma-separated payload dtypes (f32,f64)")
+    ap.add_argument("--transforms", default="r2c",
+                    help="comma-separated transforms (r2c,c2c)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline of the driven traffic")
+    ap.add_argument("--warmup", type=int, default=1,
+                    help="synchronous warmup requests per traffic cell "
+                         "before the measured window (0 = cold)")
+    ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def _parse_shapes(s: str):
+    out = []
+    for part in s.split(","):
+        part = part.strip().lower()
+        if not part:
+            continue
+        nx, _, ny = part.partition("x")
+        out.append((int(nx), int(ny or nx)))
+    if not out:
+        raise SystemExit("--shapes needs at least one NXxNY entry")
+    return out
+
+
+def _make_http(server, port: int):
+    """Stdlib HTTP front end; returns the started ThreadingHTTPServer."""
+    import io
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import numpy as np
+
+    from ..resilience.circuit import CircuitOpen
+    from ..resilience.deadline import DeadlineExceeded
+    from .server import Overloaded, ServerClosed
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet: obs is the log surface
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload, sort_keys=True).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, server.health())
+            elif self.path == "/readyz":
+                ready = server.state == "running"
+                self._json(200 if ready else 503,
+                           {"ready": ready, "state": server.state})
+            else:
+                self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/fft":
+                self._json(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                x = np.load(io.BytesIO(self.rfile.read(n)),
+                            allow_pickle=False)
+                transform = self.headers.get("X-DFFT-Transform", "r2c")
+                direction = self.headers.get("X-DFFT-Direction", "forward")
+                ny = self.headers.get("X-DFFT-Ny")
+                ddl = self.headers.get("X-DFFT-Deadline-Ms")
+                out = server.request(
+                    x, transform, direction,
+                    ny=int(ny) if ny else None,
+                    deadline_ms=float(ddl) if ddl else None)
+            except Overloaded as e:
+                self._json(429, {"error": "overloaded", "reason": e.reason,
+                                 "queue_depth": e.queue_depth,
+                                 "est_delay_ms": e.est_delay_ms})
+            except CircuitOpen as e:
+                self._json(503, {"error": "circuit_open", "key": e.key,
+                                 "retry_after_s": e.retry_after_s})
+            except ServerClosed:
+                self._json(503, {"error": "closed"})
+            except DeadlineExceeded as e:
+                self._json(504, {"error": "deadline_exceeded",
+                                 "detail": e.detail,
+                                 "overrun_ms": e.overrun_ms})
+            except (ValueError, OSError) as e:
+                self._json(400, {"error": "bad_request", "detail": str(e)})
+            except Exception as e:  # noqa: BLE001 — the envelope's edge
+                self._json(500, {"error": type(e).__name__,
+                                 "detail": str(e)[:300]})
+            else:
+                buf = io.BytesIO()
+                np.save(buf, out, allow_pickle=False)
+                body = buf.getvalue()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="dfft-serve-http").start()
+    return httpd
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .. import obs
+    if args.obs_dir:
+        obs.enable(args.obs_dir)
+    if args.obs:
+        obs.enable_console()
+
+    if args.emulate_devices:
+        from ..parallel.mesh import force_cpu_devices
+        force_cpu_devices(args.emulate_devices)
+
+    from .. import params as pm
+    from .server import Server
+
+    cfg = pm.Config(
+        comm_method=pm.parse_comm_method(args.comm_method),
+        opt=args.opt, fft_backend=args.fft_backend,
+        wire_dtype=args.wire_dtype, guards=args.guards,
+        wisdom_path=args.wisdom, use_wisdom=not args.no_wisdom)
+    server = Server(
+        pm.SlabPartition(args.partitions), cfg, shard=args.shard,
+        max_queue=args.max_queue,
+        latency_budget_ms=args.latency_budget_ms,
+        max_coalesce=args.max_coalesce,
+        batch_chunk=args.batch_chunk or None,
+        cache_capacity=args.cache_capacity, circuit_k=args.circuit_k,
+        circuit_cooldown_s=args.circuit_cooldown_s)
+
+    httpd = _make_http(server, args.http) if args.http else None
+    stop = threading.Event()
+
+    def _graceful(signum, frame):  # noqa: ARG001 — signal contract
+        print(f"dfft-serve: signal {signum} -> graceful drain",
+              flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    rc = 0
+    summary = None
+    health = None
+    try:
+        if args.drive:
+            from ..testing.workloads import serve_load
+            kw = dict(rate_hz=args.rate,
+                      shapes=_parse_shapes(args.shapes),
+                      dtypes=[d.strip() for d in args.dtypes.split(",")
+                              if d.strip()],
+                      transforms=[t.strip() for t in
+                                  args.transforms.split(",") if t.strip()],
+                      deadline_ms=args.deadline_ms, seed=args.seed,
+                      warmup=args.warmup, stop=stop)
+            if args.requests:
+                kw["n_requests"] = args.requests
+            else:
+                kw["duration_s"] = args.duration
+            summary = serve_load(server, **kw)
+            health = server.health()  # LIVE state (degraded circuits
+            # etc.) before the drain below flips status to stopped
+        else:
+            print(f"dfft-serve: serving (state {server.state}"
+                  + (f", http :{args.http}" if httpd else "")
+                  + "); SIGTERM drains", flush=True)
+            while not stop.is_set():
+                stop.wait(0.2)
+    finally:
+        server.close(drain=True)
+        if httpd is not None:
+            httpd.shutdown()
+        if health is None:
+            health = server.health()
+        if args.health_out:
+            try:
+                with open(args.health_out, "w", encoding="utf-8") as f:
+                    json.dump(health, f, indent=1, sort_keys=True)
+            except OSError as e:
+                print(f"dfft-serve: health-out failed: {e}",
+                      file=sys.stderr)
+                rc = 1
+        if summary is not None:
+            summary["health_status"] = health["status"]
+            print(json.dumps(summary, sort_keys=True), flush=True)
+        if args.obs:
+            print("obs metrics: "
+                  + json.dumps(obs.metrics.snapshot(), sort_keys=True))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

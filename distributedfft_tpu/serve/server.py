@@ -1,0 +1,604 @@
+"""The long-lived FFT server: admission control, coalescing, circuits.
+
+``Server`` is the interactive-traffic successor of the reference's
+batch-era L6 launcher (``launch.py`` + JSON job specs): one resident
+process that keeps compiled plans hot and answers 2D FFT requests under
+an explicit robustness envelope. The request path:
+
+1. **Admission** (``submit``; caller's thread, microseconds): a closed or
+   draining server rejects with :class:`ServerClosed`; a key whose
+   circuit is open rejects with ``CircuitOpen``; then the BOUNDED queue
+   sheds load — queue full, estimated queue delay (depth x per-request
+   EMA) over the latency budget, or over the request's own deadline —
+   with a structured :class:`Overloaded` carrying the numbers the client
+   needs to back off. Queueing is never unbounded latency.
+2. **Coalescing** (worker thread): the queue head is batched with every
+   queued request that shares its coalescing key (shape/dtype/transform,
+   ``plancache.request_key``) and direction, up to ``max_coalesce``; the
+   stack executes as ONE ``Batched2DFFTPlan`` program from the LRU plan
+   cache (power-of-two batch buckets; ``batch_chunk=1`` by default, the
+   per-plane ``lax.map`` rendering — bit-identical to single-shot
+   execution AND the measured winner at large planes, bench 2026-07-31).
+3. **Execution envelope**: per-request deadlines propagate cooperatively
+   (``resilience.deadline.scope``) into the PR 5 fallback ladder, an
+   expired request is answered ``DeadlineExceeded`` WITHOUT executing,
+   and the whole batch runs inside the per-key circuit breaker — K
+   consecutive failures open the circuit (fast structured rejection,
+   plan-cache entries invalidated so the half-open probe rebuilds),
+   transitions land in the event log as ``serve.circuit.*``.
+4. **Observability**: ``health()`` is the readiness snapshot (status,
+   queue depth, shed counts, per-circuit state, plan-cache hit rate, the
+   PR 4 metrics registry); every decision is an ``obs`` event/metric.
+5. **Drain** (``close(drain=True)`` — the CLI's SIGTERM handler): stop
+   admitting (new submits get ``ServerClosed``), finish everything
+   already admitted, then stop the worker and emit ``serve.drain`` /
+   ``serve.stop``. Wisdom writes and event-log lines are flushed as they
+   happen (atomic replace / per-line append), so a drained process
+   leaves no buffered state behind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from .. import params as pm
+from ..resilience import deadline as dl
+from ..resilience import inject
+from ..resilience.circuit import CircuitBreaker
+from ..resilience.deadline import Deadline, DeadlineExceeded
+from . import plancache
+
+
+class Overloaded(RuntimeError):
+    """Structured load-shed rejection: the request was NOT admitted.
+    ``reason`` is ``queue_full`` | ``latency_budget`` | ``deadline`` —
+    the queue would have held it longer than the budget (or its own
+    deadline) allows."""
+
+    def __init__(self, reason: str, queue_depth: int, est_delay_ms: float,
+                 budget_ms: float):
+        super().__init__(
+            f"overloaded ({reason}): queue depth {queue_depth}, estimated "
+            f"delay {est_delay_ms:.1f} ms, budget {budget_ms:.1f} ms")
+        self.reason = reason
+        self.queue_depth = int(queue_depth)
+        self.est_delay_ms = float(est_delay_ms)
+        self.budget_ms = float(budget_ms)
+
+
+class ServerClosed(RuntimeError):
+    """The server is draining or stopped; no new work is admitted."""
+
+
+@dataclasses.dataclass
+class _Request:
+    x: np.ndarray
+    nx: int
+    ny: int
+    transform: str
+    double: bool
+    direction: str
+    base_key: str
+    deadline: Optional[Deadline]
+    future: Future
+    submitted_at: float
+
+    def coalesce_key(self) -> Tuple[str, str]:
+        return (self.base_key, self.direction)
+
+
+_EMA_ALPHA = 0.2
+
+
+class Server:
+    """In-process FFT-as-a-service core (see module docstring).
+
+    Parameters mirror a production serving config: ``max_queue`` bounds
+    the admission queue, ``latency_budget_ms`` is the shed threshold on
+    estimated queue delay, ``max_coalesce`` caps the stacked batch,
+    ``circuit_k``/``circuit_cooldown_s`` parameterize the per-key
+    breaker, and ``config`` is the Config TEMPLATE every served plan is
+    built from (wire/guards/comm surface; ``double_prec`` is overridden
+    per request from the payload dtype). ``shard`` picks the batched2d
+    decomposition: ``"batch"`` (default — embarrassingly parallel,
+    coalescing-friendly) or ``"x"`` (slab-style with a real exchange —
+    the decomposition the chaos drill targets with wire faults)."""
+
+    def __init__(self, partition: Optional[pm.SlabPartition] = None,
+                 config: Optional[pm.Config] = None, mesh: Any = None,
+                 shard: str = "batch", *, max_queue: int = 64,
+                 latency_budget_ms: float = 1000.0, max_coalesce: int = 8,
+                 batch_chunk: Optional[int] = 1, cache_capacity: int = 8,
+                 circuit_k: int = 3, circuit_cooldown_s: float = 5.0,
+                 name: str = "dfft-serve"):
+        if shard not in ("batch", "x"):
+            raise ValueError(f"shard must be 'batch' or 'x', got {shard!r}")
+        if max_queue < 1 or max_coalesce < 1:
+            raise ValueError("max_queue and max_coalesce must be >= 1")
+        self.partition = partition or pm.SlabPartition(1)
+        self.config = config or pm.Config()
+        self.mesh = mesh
+        self.shard = shard
+        self.max_queue = int(max_queue)
+        self.latency_budget_ms = float(latency_budget_ms)
+        self.max_coalesce = int(max_coalesce)
+        self.batch_chunk = batch_chunk if shard == "batch" else None
+        self.circuit_k = int(circuit_k)
+        self.circuit_cooldown_s = float(circuit_cooldown_s)
+        self.name = name
+        self.cache = plancache.PlanCache(cache_capacity)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: List[_Request] = []
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._ema_ms: Optional[float] = None
+        self._state = "running"  # running | draining | stopped
+        self._started_at = time.monotonic()
+        self._counts = {"admitted": 0, "served": 0, "shed": 0,
+                        "rejected_closed": 0, "rejected_circuit": 0,
+                        "deadline_expired": 0, "batches": 0,
+                        "batch_failures": 0, "coalesced": 0}
+        self._inflight = 0
+        obs.event("serve.start", server=name, shard=shard,
+                  ranks=self.partition.num_ranks, max_queue=max_queue,
+                  latency_budget_ms=latency_budget_ms,
+                  max_coalesce=max_coalesce, circuit_k=circuit_k)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{name}-worker")
+        self._worker.start()
+
+    # -- context manager ---------------------------------------------------
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close(drain=True)
+
+    # -- admission ---------------------------------------------------------
+
+    def _normalize(self, x: Any, transform: str, direction: str,
+                   ny: Optional[int]) -> Tuple[np.ndarray, int, int, bool]:
+        """Validate one request payload; returns ``(x, nx, ny, double)``
+        with ``ny`` the LOGICAL real width (needed to key/construct the
+        plan — a spectral r2c payload alone cannot distinguish even/odd
+        ny, so inverse r2c callers may pass it; default assumes even)."""
+        if transform not in ("r2c", "c2c"):
+            raise ValueError(f"transform must be r2c|c2c, got {transform!r}")
+        if direction not in ("forward", "inverse"):
+            raise ValueError(
+                f"direction must be forward|inverse, got {direction!r}")
+        x = np.asarray(x)
+        if x.ndim != 2:
+            raise ValueError(
+                f"serve requests are single 2D images, got shape {x.shape} "
+                "(batching is the server's job — submit images "
+                "concurrently and they coalesce)")
+        complex_in = (transform == "c2c") or (direction == "inverse")
+        if complex_in != np.iscomplexobj(x):
+            raise ValueError(
+                f"{transform} {direction} expects a "
+                f"{'complex' if complex_in else 'real'} payload, got "
+                f"dtype {x.dtype}")
+        double = x.dtype in (np.float64, np.complex128)
+        if transform == "c2c" or direction == "forward":
+            nx_, ny_ = int(x.shape[0]), int(x.shape[1])
+            if ny is not None and int(ny) != ny_:
+                raise ValueError(f"ny {ny} disagrees with payload {x.shape}")
+            return x, nx_, ny_, double
+        # inverse r2c: payload is (nx, ny//2 + 1) spectral
+        nx_, nys = int(x.shape[0]), int(x.shape[1])
+        ny_ = int(ny) if ny is not None else 2 * (nys - 1)
+        if ny_ // 2 + 1 != nys:
+            raise ValueError(
+                f"ny {ny_} inconsistent with spectral payload {x.shape} "
+                f"(expects ny//2+1 == {nys})")
+        return x, nx_, ny_, double
+
+    def _breaker(self, key: str) -> CircuitBreaker:
+        """Caller holds the lock. The map is BOUNDED like the plan cache
+        (an adversarial shape sweep must not grow server memory or the
+        /healthz payload without limit): over the cap, idle breakers —
+        closed with zero consecutive failures, i.e. carrying no state
+        worth keeping — are pruned; open/half-open/failing ones always
+        survive."""
+        b = self._breakers.get(key)
+        if b is None:
+            cap = max(64, 8 * self.cache.capacity)
+            if len(self._breakers) >= cap:
+                for k in [k for k, v in self._breakers.items()
+                          if v.state == "closed"
+                          and v.snapshot()["consecutive_failures"] == 0]:
+                    del self._breakers[k]
+            b = CircuitBreaker(key, self.circuit_k, self.circuit_cooldown_s,
+                               metrics_prefix="serve.circuit")
+            self._breakers[key] = b
+        return b
+
+    def _shed(self, reason: str, depth: int, est_ms: float,
+              budget_ms: float) -> Overloaded:
+        self._counts["shed"] += 1
+        obs.metrics.inc("serve.shed")
+        obs.event("serve.shed", reason=reason, queue_depth=depth,
+                  est_delay_ms=round(est_ms, 2),
+                  budget_ms=round(budget_ms, 2))
+        return Overloaded(reason, depth, est_ms, budget_ms)
+
+    def submit(self, x: Any, transform: str = "r2c",
+               direction: str = "forward", *, ny: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one 2D FFT request; returns a ``Future`` resolving to the
+        result array, or raising the structured rejection
+        (:class:`Overloaded` / ``CircuitOpen`` / :class:`ServerClosed` /
+        ``DeadlineExceeded``). Admission itself raises — a rejected
+        request never occupies the queue."""
+        x, nx, ny_, double = self._normalize(x, transform, direction, ny)
+        key = plancache.request_key(
+            nx, ny_, "f64" if double else "f32", transform, self.shard)
+        deadline = (Deadline.after_ms(deadline_ms)
+                    if deadline_ms is not None else None)
+        with self._lock:
+            if self._state != "running":
+                self._counts["rejected_closed"] += 1
+                obs.metrics.inc("serve.rejected_closed")
+                raise ServerClosed(f"server is {self._state}; "
+                                   "not admitting new requests")
+            breaker = self._breaker(key)
+            if (breaker.state == "open"
+                    and breaker.retry_after_s() > 0):
+                self._counts["rejected_circuit"] += 1
+                raise breaker.reject()
+            depth = len(self._pending) + self._inflight
+            est_ms = (depth * self._ema_ms) if self._ema_ms else 0.0
+            if len(self._pending) >= self.max_queue:
+                # est_ms (not inf): the rejection must serialize as
+                # strict JSON in the HTTP 429 body and the event log.
+                raise self._shed("queue_full", depth, est_ms,
+                                 self.latency_budget_ms)
+            if est_ms > self.latency_budget_ms:
+                raise self._shed("latency_budget", depth, est_ms,
+                                 self.latency_budget_ms)
+            if deadline is not None and est_ms >= deadline.remaining_ms():
+                raise self._shed("deadline", depth, est_ms,
+                                 deadline.remaining_ms())
+            fut: Future = Future()
+            req = _Request(x=x, nx=nx, ny=ny_, transform=transform,
+                           double=double, direction=direction,
+                           base_key=key, deadline=deadline, future=fut,
+                           submitted_at=time.monotonic())
+            self._pending.append(req)
+            self._counts["admitted"] += 1
+            obs.metrics.inc("serve.requests")
+            obs.metrics.gauge("serve.queue_depth", len(self._pending))
+            self._cv.notify()
+            return fut
+
+    def request(self, x: Any, transform: str = "r2c",
+                direction: str = "forward", *, ny: Optional[int] = None,
+                deadline_ms: Optional[float] = None,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience wrapper over :meth:`submit`."""
+        return self.submit(x, transform, direction, ny=ny,
+                           deadline_ms=deadline_ms).result(timeout_s)
+
+    # -- worker ------------------------------------------------------------
+
+    def _take_batch(self) -> List[_Request]:
+        """Caller holds the lock: pop the queue head plus every queued
+        request sharing its coalescing key and direction (FIFO order
+        within the key), up to ``max_coalesce``."""
+        head = self._pending.pop(0)
+        batch = [head]
+        if self.max_coalesce > 1:
+            keep: List[_Request] = []
+            for r in self._pending:
+                if (len(batch) < self.max_coalesce
+                        and r.coalesce_key() == head.coalesce_key()):
+                    batch.append(r)
+                else:
+                    keep.append(r)
+            self._pending = keep
+        obs.metrics.gauge("serve.queue_depth", len(self._pending))
+        self._inflight = len(batch)
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and self._state == "running":
+                    self._cv.wait(0.05)
+                if not self._pending:
+                    break  # draining/stopped and drained
+                batch = self._take_batch()
+            try:
+                self._execute(batch)
+            except Exception as err:  # noqa: BLE001 — the worker is the
+                # only serving thread: ANY escape (a malformed fault spec
+                # raising in the injector, an obs path failing) must fail
+                # THIS batch loudly and keep serving, never die silently
+                # with futures dangling and close() left to hang.
+                obs.metrics.inc("serve.batch_failures")
+                obs.notice(
+                    f"serve: worker error outside the execution envelope "
+                    f"({type(err).__name__}: {err})"[:300],
+                    name="serve.worker_error")
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+            finally:
+                with self._lock:
+                    self._inflight = 0
+
+    def _expire(self, req: _Request, detail: str) -> None:
+        self._counts["deadline_expired"] += 1
+        obs.metrics.inc("serve.deadline_expired")
+        over = -req.deadline.remaining_ms() if req.deadline else 0.0
+        obs.event("serve.deadline_expired", key=req.base_key, detail=detail,
+                  overrun_ms=round(over, 2))
+        req.future.set_exception(DeadlineExceeded(
+            f"deadline exceeded by {over:.1f} ms ({detail})",
+            detail=detail, overrun_ms=over))
+
+    def _make_plan(self, nx: int, ny: int, transform: str, double: bool,
+                   bucket: int) -> Any:
+        from ..models.batched2d import Batched2DFFTPlan
+        cfg = dataclasses.replace(self.config, double_prec=double)
+        ck = self.batch_chunk
+        if ck:
+            # batch_chunk must divide the plan's LOCAL padded batch
+            # (models/batched2d.py contract); a configured chunk larger
+            # than a small bucket's local batch clamps to its largest
+            # divisor — an uncoalesced request must not be unbuildable
+            # under --batch-chunk > 1.
+            P = self.partition.p
+            local_b = bucket if P <= 1 else pm.padded_extent(bucket, P) // P
+            ck = max(d for d in range(1, min(ck, local_b) + 1)
+                     if local_b % d == 0)
+        return Batched2DFFTPlan(
+            bucket, nx, ny, self.partition, cfg, mesh=self.mesh,
+            shard=self.shard, transform=transform, batch_chunk=ck)
+
+    def _build_plan(self, req: _Request, bucket: int) -> Any:
+        return self._make_plan(req.nx, req.ny, req.transform, req.double,
+                               bucket)
+
+    def prewarm(self, shape: Tuple[int, int], dtype: Any = None,
+                transform: str = "r2c", *,
+                directions: Tuple[str, ...] = ("forward",)) -> int:
+        """Build + compile the plan-cache slots one traffic shape needs —
+        every power-of-two coalescing bucket up to ``max_coalesce`` —
+        BEFORE traffic arrives, so no request ever stalls behind a lazy
+        bucket compile (a rolling restart calls this between bind and
+        ready). Runs in the caller's thread against the shared cache;
+        call it before serving traffic, not during. Returns the number of
+        plans newly built."""
+        nx, ny = int(shape[0]), int(shape[1])
+        dt = np.dtype(dtype) if dtype is not None else np.dtype(np.float32)
+        double = dt in (np.float64, np.complex128)
+        key = plancache.request_key(nx, ny, "f64" if double else "f32",
+                                    transform, self.shard)
+        built = 0
+        # Enumerate exactly the buckets bucket_for can produce (powers of
+        # two through the pow2 CEILING of max_coalesce).
+        top = plancache.bucket_for(self.max_coalesce, self.max_coalesce)
+        b = 1
+        while b <= top:
+            ckey = plancache.cache_key(key, b)
+            plan, hit = self.cache.get_or_build(
+                ckey, lambda b=b: self._make_plan(nx, ny, transform,
+                                                  double, b))
+            if not hit:
+                built += 1
+            if transform == "c2c":
+                cdt = np.complex128 if double else np.complex64
+                x = np.zeros((b, nx, ny), cdt)
+            else:
+                x = np.zeros((b, nx, ny),
+                             np.float64 if double else np.float32)
+            if "forward" in directions:
+                np.asarray(plan.exec_forward(x))
+            if "inverse" in directions:
+                if transform == "c2c":
+                    np.asarray(plan.exec_inverse(
+                        np.zeros((b, nx, ny),
+                                 np.complex128 if double else np.complex64)))
+                else:
+                    np.asarray(plan.exec_inverse(
+                        np.zeros((b, nx, ny // 2 + 1),
+                                 np.complex128 if double else np.complex64)))
+            b <<= 1
+        obs.event("serve.prewarm", key=key, built=built,
+                  directions=list(directions))
+        return built
+
+    def _execute(self, batch: List[_Request]) -> None:
+        key = batch[0].base_key
+        with self._lock:
+            breaker = self._breaker(key)
+        if not breaker.allow():
+            with self._lock:
+                self._counts["rejected_circuit"] += len(batch)
+            for r in batch:
+                r.future.set_exception(breaker.reject())
+            return
+        try:
+            # The injected straggler (server:slow) ages the batch BEFORE
+            # the expiry check, exactly like a slow host would — expired
+            # requests then never execute (the test pins this).
+            inject.maybe_slow_server("serve.execute")
+            alive = []
+            for r in batch:
+                if r.deadline is not None and r.deadline.expired():
+                    self._expire(r, "queued")
+                else:
+                    alive.append(r)
+        except Exception:
+            # An escape BETWEEN a successful allow() and the execution
+            # envelope (e.g. a malformed fault spec raising inside the
+            # injector) must release the probe slot without a verdict —
+            # a leaked slot would wedge a half-open circuit forever.
+            breaker.release()
+            raise  # _run's guard fails the batch and keeps serving
+        if not alive:
+            # Nothing executed: the breaker's probe slot (if this was
+            # one) must be released without a verdict about the plan.
+            breaker.release()
+            return
+        t0 = time.perf_counter()
+        try:
+            n = len(alive)
+            bucket = plancache.bucket_for(n, self.max_coalesce)
+            ckey = plancache.cache_key(key, bucket)
+            plan, hit = self.cache.get_or_build(
+                ckey, lambda: self._build_plan(alive[0], bucket))
+            stack = np.stack([r.x for r in alive])
+            if bucket > n:
+                pad = np.zeros((bucket - n,) + stack.shape[1:], stack.dtype)
+                stack = np.concatenate([stack, pad])
+            # The ladder scope gets the LOOSEST member deadline: expiry
+            # is enforced per request before and after execution, so the
+            # ambient deadline exists only to bound fallback retries —
+            # one near-expired rider must not disable the ladder for the
+            # whole coalesced batch (and feed its joint failure to the
+            # breaker). A member WITHOUT a deadline keeps the scope open
+            # (the env-level fallback horizon still applies).
+            batch_dl: Optional[Deadline] = None
+            if all(r.deadline is not None for r in alive):
+                batch_dl = max((r.deadline for r in alive),
+                               key=lambda d: d.expires_at)
+            head = alive[0]
+            with obs.span("serve.execute", key=ckey, n=n, bucket=bucket,
+                          direction=head.direction), dl.scope(batch_dl):
+                if head.direction == "forward":
+                    out = plan.exec_forward(stack)
+                else:
+                    out = plan.exec_inverse(stack)
+                res = np.asarray(out)  # materialize: the latency is real
+        except Exception as err:  # noqa: BLE001 — every failure is a verdict
+            opened = breaker.record_failure(err)
+            if opened:
+                self.cache.invalidate_prefix(key)
+            with self._lock:
+                self._counts["batch_failures"] += 1
+            obs.metrics.inc("serve.batch_failures")
+            obs.event("serve.batch_failed", key=key, n=len(alive),
+                      error=f"{type(err).__name__}: {err}"[:300])
+            for r in alive:
+                r.future.set_exception(err)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        breaker.record_success()
+        if head.direction == "forward":
+            res = res[:n, :head.nx, :plan._ny_spec]
+        else:
+            res = res[:n, :head.nx, :head.ny]
+        with self._lock:
+            if hit:
+                # Only warm (cache-hit) executions feed the queue-delay
+                # estimator: a cold batch's latency is dominated by the
+                # one-time trace+compile, and folding it in would make
+                # admission shed steady-state traffic it can easily carry.
+                per_req = ms / n
+                self._ema_ms = (per_req if self._ema_ms is None else
+                                (1 - _EMA_ALPHA) * self._ema_ms
+                                + _EMA_ALPHA * per_req)
+                obs.metrics.gauge("serve.ema_ms", round(self._ema_ms, 4))
+            self._counts["batches"] += 1
+            self._counts["served"] += n
+            if n > 1:
+                self._counts["coalesced"] += n
+        obs.metrics.inc("serve.batches")
+        obs.metrics.inc("serve.requests_served", n)
+        if n > 1:
+            obs.metrics.inc("serve.coalesced_requests", n)
+        obs.event("serve.batch", key=ckey, n=n, bucket=bucket,
+                  ms=round(ms, 3), cache_hit=hit)
+        for i, r in enumerate(alive):
+            if r.deadline is not None and r.deadline.expired():
+                # The result exists but arrived too late: a deadline is a
+                # promise, and a late success is reported as expiry.
+                self._expire(r, "executing")
+            else:
+                r.future.set_result(np.array(res[i]))
+
+    # -- health / lifecycle ------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """The readiness snapshot (the ``/healthz`` payload): overall
+        status (``ok`` | ``degraded`` — any circuit not closed — |
+        ``draining`` | ``stopped``), queue occupancy, shed/expiry
+        counters, per-circuit state, plan-cache hit rate, and the PR 4
+        metrics registry."""
+        with self._lock:
+            circuits = {k: b.snapshot() for k, b in self._breakers.items()}
+            degraded = any(c["state"] != "closed"
+                           for c in circuits.values())
+            status = (self._state if self._state != "running"
+                      else ("degraded" if degraded else "ok"))
+            snap = {
+                "status": status,
+                "uptime_s": round(time.monotonic() - self._started_at, 3),
+                "queue_depth": len(self._pending),
+                "inflight": self._inflight,
+                "max_queue": self.max_queue,
+                "latency_budget_ms": self.latency_budget_ms,
+                "max_coalesce": self.max_coalesce,
+                "ema_ms": (round(self._ema_ms, 4)
+                           if self._ema_ms is not None else None),
+                "counters": dict(self._counts),
+                "circuits": circuits,
+            }
+        snap["plan_cache"] = self.cache.snapshot()
+        snap["obs_metrics"] = obs.snapshot()
+        return snap
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def close(self, drain: bool = True, timeout_s: float = 60.0) -> None:
+        """Stop the server. ``drain=True`` (the SIGTERM path): reject new
+        submits, FINISH everything already admitted, then stop.
+        ``drain=False``: stop now; queued requests fail with
+        :class:`ServerClosed`. Idempotent. Wisdom records and event-log
+        lines were flushed as they were written (atomic replace /
+        per-line append); the final ``serve.stop`` event carries the
+        counter totals as the run's closing record."""
+        with self._cv:
+            if self._state == "stopped":
+                return
+            already_draining = self._state == "draining"
+            self._state = "draining"
+            pending = len(self._pending)
+            if not already_draining:
+                # notice() both prints (--obs) and logs ONE serve.drain
+                # event carrying the structured attrs.
+                obs.notice(f"serve: draining ({pending} queued, "
+                           f"drain={drain})", name="serve.drain",
+                           drain=drain, pending=pending)
+            if not drain:
+                for r in self._pending:
+                    r.future.set_exception(
+                        ServerClosed("server closed before execution"))
+                self._pending.clear()
+            self._cv.notify_all()
+        self._worker.join(timeout_s)
+        with self._cv:
+            self._state = "stopped"
+            leftovers = self._pending
+            self._pending = []
+        for r in leftovers:  # worker died/timed out with work queued
+            if not r.future.done():
+                r.future.set_exception(
+                    ServerClosed("server stopped before execution"))
+        obs.notice(f"serve: stopped ({self._counts['served']} served, "
+                   f"{self._counts['shed']} shed)", name="serve.stop",
+                   counters=dict(self._counts))

@@ -575,7 +575,10 @@ def stamp_demotion(store: "WisdomStore", key: str, slot: str, rung: str,
     (``_comm_hit_fold``/``_wire_hit_fold``), so the store stops
     recommending the failing cell until a fresh race re-records it (a new
     ``record()`` of the slot replaces the stamped dict wholesale,
-    clearing the stamp). A slot with no record gets a bare stamp — it
+    clearing the stamp) — OR until the stamp's TTL expires
+    (``$DFFT_DEMOTION_TTL_S``, default 24 h; see
+    :func:`demotion_active`): a transient failure must not permanently
+    demote a cell. A slot with no record gets a bare stamp — it
     already reads as a miss, but the stamp preserves WHY for
     ``dfft-explain``. Best-effort like every wisdom write."""
     rec = store.lookup(key, slot) or {}
@@ -593,6 +596,55 @@ def stamp_demotion(store: "WisdomStore", key: str, slot: str, rung: str,
             name="wisdom.demotion", slot=slot, rung=rung,
             store=store.path)
     return ok
+
+
+DEMOTION_TTL_ENV = "DFFT_DEMOTION_TTL_S"
+_DEMOTION_TTL_DEFAULT_S = 86400.0  # 24 h
+
+
+def _demotion_ttl_s() -> float:
+    try:
+        return float(os.environ.get(DEMOTION_TTL_ENV,
+                                    str(_DEMOTION_TTL_DEFAULT_S)))
+    except ValueError:
+        return _DEMOTION_TTL_DEFAULT_S
+
+
+def demotion_active(rec: Optional[Dict[str, Any]]) -> bool:
+    """Whether a demotion stamp on ``rec`` is still IN FORCE. Stamps age
+    out after ``$DFFT_DEMOTION_TTL_S`` seconds (default 24 h; ``<= 0``
+    restores the pre-TTL permanent-stamp behavior): a transient failure —
+    a flaky link, a one-off compile hiccup — must not permanently demote
+    a cell the store once measured as the winner. An expired stamp reads
+    as a hit again (noticed once per read via ``wisdom.demotion_expired``
+    so the re-admission is visible in the event log); the stamp itself
+    stays on disk until the next ``record()`` replaces it, preserving the
+    failure history for ``dfft-explain``. A stamp whose ``demoted_at``
+    is missing or unparseable never expires (conservative: the failure
+    evidence is real even if its clock is not)."""
+    if not rec or not rec.get("demoted"):
+        return False
+    ttl = _demotion_ttl_s()
+    if ttl <= 0:
+        return True
+    stamped = rec.get("demoted_at")
+    if not isinstance(stamped, str):
+        return True
+    try:
+        import calendar
+        t = calendar.timegm(time.strptime(stamped, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return True
+    age = time.time() - t
+    if age <= ttl:
+        return True
+    obs.metrics.inc("wisdom.demotion_expired")
+    obs.notice(
+        f"wisdom: demotion stamp expired ({age:.0f} s > ttl {ttl:.0f} s, "
+        f"rung {rec.get('demoted_rung')}) — record re-admitted",
+        name="wisdom.demotion_expired", rung=rec.get("demoted_rung"),
+        age_s=round(age, 1), ttl_s=ttl)
+    return False
 
 
 def _valid_local_rec(rec: Dict[str, Any]) -> bool:
@@ -699,10 +751,11 @@ def _comm_hit_fold(norm_base: Any, rec: Dict[str, Any], race_wire: bool,
     would do."""
     if rec is None:
         return None, "no record"
-    if rec.get("demoted"):
+    if demotion_active(rec):
         # Resilience fallback stamped this cell after a runtime failure
         # (lowering/compile/guard): the store must stop recommending it.
-        # A miss re-races and re-records, clearing the stamp.
+        # A miss re-races and re-records, clearing the stamp; an aged
+        # stamp ($DFFT_DEMOTION_TTL_S) expires and reads as a hit again.
         return None, "record demoted after a runtime failure"
     try:
         folded = _fold_comm_rec(norm_base, rec)
@@ -734,7 +787,7 @@ def _wire_hit_fold(base: Any, rec: Dict[str, Any], budget: float) -> Any:
     ``peek_config``)."""
     if rec is None:
         return None, "no record"
-    if rec.get("demoted"):
+    if demotion_active(rec):
         return None, "record demoted after a runtime failure"
     try:
         folded = _fold_wire_rec(base, rec)

@@ -51,7 +51,7 @@ def _resilience_hygiene(monkeypatch):
     for var in (inject.ENV_VAR, "DFFT_GUARDS", "DFFT_FALLBACK",
                 "DFFT_WISDOM_LOCK_TIMEOUT_S", "DFFT_WISDOM_LOCK_STALE_S",
                 "DFFT_AUTOTUNE_CELL_TIMEOUT_S", "DFFT_COORD_RETRIES",
-                "DFFT_COORD_BACKOFF_S"):
+                "DFFT_COORD_BACKOFF_S", "DFFT_DEMOTION_TTL_S"):
         monkeypatch.delenv(var, raising=False)
     obs.reset()
     yield
@@ -82,10 +82,44 @@ def test_fault_spec_grammar():
     assert parse_fault_spec(str(s)) == s
     assert parse_fault_spec("coordinator:down:2").param == 2
     assert parse_fault_spec("wisdom:stale-lock").mode == "stale-lock"
+    # the serve straggler fault (ISSUE 8) parses like every other kind
+    srv = parse_fault_spec("server:slow:25")
+    assert (srv.kind, srv.mode, srv.param) == ("server", "slow", 25.0)
+    assert parse_fault_spec("server:slow").param is None
     for bad in ("wire", "wire:frobnicate", "bogus:nan", "wire:nan@x=1",
-                "wire:nan:oops:extra"):
+                "wire:nan:oops:extra", "server:fast", "server"):
         with pytest.raises(ValueError):
             parse_fault_spec(bad)
+
+
+def test_multi_fault_spec_grammar(monkeypatch):
+    from distributedfft_tpu.resilience.inject import (active, active_specs,
+                                                      parse_fault_specs)
+    specs = parse_fault_specs("wire:bitflip,server:slow:40@seed=3")
+    assert [s.kind for s in specs] == ["wire", "server"]
+    assert specs[1].param == 40.0 and specs[1].seed == 3
+    # strict: empty elements, malformed members, duplicate kinds all fail
+    for bad in ("wire:nan,,", ",server:slow", "wire:nan,bogus:x",
+                "wire:nan,wire:bitflip"):
+        with pytest.raises(ValueError):
+            parse_fault_specs(bad)
+    monkeypatch.setenv(inject.ENV_VAR, "wire:nan,server:slow:5")
+    assert [s.kind for s in active_specs()] == ["wire", "server"]
+    assert active().kind == "wire"  # legacy first-spec accessor
+    assert inject._spec_of("server").param == 5.0
+    assert inject._spec_of("autotune") is None
+
+
+def test_server_slow_injector(monkeypatch):
+    monkeypatch.setenv(inject.ENV_VAR, "server:slow:60")
+    t0 = time.perf_counter()
+    inject.maybe_slow_server("test")
+    assert time.perf_counter() - t0 >= 0.055
+    assert obs.metrics.counter_value("inject.server_slow") == 1
+    monkeypatch.delenv(inject.ENV_VAR)
+    t0 = time.perf_counter()
+    inject.maybe_slow_server("test")  # inactive: no sleep
+    assert time.perf_counter() - t0 < 0.05
 
 
 def test_guards_mode_resolution(monkeypatch):
@@ -346,6 +380,46 @@ def test_demotion_stamps_wisdom_and_reads_as_miss(tmp_path, devices,
     # A stamped record reads as a miss: the store stops recommending it.
     folded, reason = wisdom._comm_hit_fold(dfft.Config(), rec, False, 2e-2)
     assert folded is None and "demoted" in reason
+
+
+def test_demotion_stamp_ttl_expiry(monkeypatch):
+    """ISSUE 8 satellite: a transient failure must not PERMANENTLY demote
+    a cell — stamps age out after $DFFT_DEMOTION_TTL_S (default 24 h),
+    after which the record reads as a hit again (with an obs notice)."""
+    fresh = {"comm_method": "All2All", "opt": 0, "wire_dtype": "native",
+             "demoted": True, "demoted_rung": "send",
+             "demoted_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())}
+    old = dict(fresh, demoted_at="2020-01-01T00:00:00Z")
+    base = dfft.Config()
+    # fresh stamp, default TTL: still demoted
+    assert wisdom.demotion_active(fresh)
+    folded, reason = wisdom._comm_hit_fold(base, fresh, False, 2e-2)
+    assert folded is None and "demoted" in reason
+    # ancient stamp, default TTL: EXPIRED — reads as a hit again
+    assert not wisdom.demotion_active(old)
+    folded, reason = wisdom._comm_hit_fold(base, old, False, 2e-2)
+    assert folded is not None and reason is None
+    assert obs.metrics.counter_value("wisdom.demotion_expired") >= 1
+    # the wire slot shares the expiry
+    wrec = {"wire_dtype": "native", "demoted": True,
+            "demoted_at": "2020-01-01T00:00:00Z"}
+    folded, reason = wisdom._wire_hit_fold(base, wrec, 2e-2)
+    assert folded is not None and reason is None
+    # TTL <= 0 restores the permanent-stamp behavior
+    monkeypatch.setenv(wisdom.DEMOTION_TTL_ENV, "0")
+    assert wisdom.demotion_active(old)
+    # a tiny TTL expires even a fresh stamp
+    monkeypatch.setenv(wisdom.DEMOTION_TTL_ENV, "0.000001")
+    time.sleep(0.01)
+    assert not wisdom.demotion_active(fresh)
+    # missing/unparseable demoted_at never expires (conservative)
+    monkeypatch.setenv(wisdom.DEMOTION_TTL_ENV, "1")
+    assert wisdom.demotion_active({"demoted": True})
+    assert wisdom.demotion_active({"demoted": True, "demoted_at": "bogus"})
+    # an unstamped record is never "demotion active"
+    assert not wisdom.demotion_active({"comm_method": "All2All"})
+    assert not wisdom.demotion_active(None)
 
 
 def test_guard_violation_not_retried_by_ladder(devices, monkeypatch):

@@ -1,0 +1,539 @@
+"""Serving layer (distributedfft_tpu/serve/) — ISSUE 8:
+
+* plan cache: strict LRU eviction order, hit rate accounting, prefix
+  invalidation, and the zero-recompile pin (a cache hit performs no plan
+  build and no new lowering — pinned via build counts);
+* coalescing: concurrent same-shape requests execute as ONE stacked
+  batched2d program whose per-request results are BIT-IDENTICAL to
+  sequential single-shot execution;
+* deadlines: an expired request is answered ``DeadlineExceeded`` and
+  NEVER executes (pinned via exec counts), including under the injected
+  ``server:slow`` straggler; nested deadline scopes only tighten;
+* admission control: bounded queue + latency-budget shedding with
+  structured ``Overloaded`` rejections carrying the backoff numbers;
+* circuit breaker: K consecutive failures open the per-key circuit
+  (health degraded, fast ``CircuitOpen`` rejections, plan cache
+  invalidated), the half-open probe re-admits after the cooldown and
+  closes on success — driven end-to-end by injected wire faults on the
+  shard='x' decomposition over the 8-device CPU mesh;
+* graceful drain: queued work finishes, new submits reject, and the obs
+  event log carries the serve.* evidence chain.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import obs
+from distributedfft_tpu.resilience import circuit as rc
+from distributedfft_tpu.resilience import deadline as dl
+from distributedfft_tpu.resilience import inject
+from distributedfft_tpu.resilience.guards import GuardViolation
+from distributedfft_tpu.serve import (Overloaded, PlanCache, Server,
+                                      ServerClosed, bucket_for, cache_key,
+                                      request_key)
+from distributedfft_tpu.testing.workloads import serve_load
+
+
+@pytest.fixture(autouse=True)
+def _serve_hygiene(monkeypatch):
+    """Clean metrics and no fault/guard env around every test."""
+    for var in (inject.ENV_VAR, "DFFT_GUARDS", "DFFT_FALLBACK",
+                "DFFT_DEMOTION_TTL_S"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _img(shape=(24, 24), seed=0, dtype=np.float32):
+    return np.random.default_rng(seed).random(shape, dtype=np.float64) \
+        .astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# deadline primitives
+# ---------------------------------------------------------------------------
+
+def test_deadline_scope_tightens():
+    outer = dl.Deadline.after_ms(10_000)
+    inner = dl.Deadline.after_ms(50)
+    assert dl.current() is None
+    with dl.scope(outer) as eff:
+        assert eff is outer and dl.current() is outer
+        with dl.scope(inner) as eff2:
+            assert eff2 is inner  # tighter wins
+        # a LOOSER inner scope cannot extend the budget
+        with dl.scope(dl.Deadline.after_ms(99_000)) as eff3:
+            assert eff3 is outer
+        assert dl.current() is outer
+    assert dl.current() is None
+    # scope(None) is a pass-through
+    with dl.scope(None) as eff4:
+        assert eff4 is None
+
+
+def test_deadline_check_raises():
+    with dl.scope(dl.Deadline(time.monotonic() - 0.01)):
+        with pytest.raises(dl.DeadlineExceeded) as ei:
+            dl.check("unit")
+        assert ei.value.detail == "unit"
+        assert ei.value.overrun_ms > 0
+    dl.check("no ambient deadline -> no raise")
+    assert dl.remaining_s(123.0) == 123.0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+def test_circuit_lifecycle():
+    b = rc.CircuitBreaker("k", failure_threshold=3, cooldown_s=0.15,
+                          metrics_prefix="serve.circuit")
+    assert b.state == "closed" and b.allow()
+    assert not b.record_failure(RuntimeError("one"))
+    assert not b.record_failure(RuntimeError("two"))
+    b.record_success()  # success resets the consecutive count
+    assert not b.record_failure(RuntimeError("one again"))
+    assert not b.record_failure(RuntimeError("two again"))
+    assert b.record_failure(RuntimeError("three"))  # opens
+    assert b.state == "open" and not b.allow()
+    assert b.retry_after_s() > 0
+    assert isinstance(b.reject(), rc.CircuitOpen)
+    time.sleep(0.2)
+    assert b.allow()                # half-open probe slot
+    assert b.state == "half_open"
+    assert not b.allow()            # only one probe at a time
+    b.record_failure(RuntimeError("probe failed"))
+    assert b.state == "open"        # re-opened
+    time.sleep(0.2)
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    snap = b.snapshot()
+    assert snap["state"] == "closed" and snap["consecutive_failures"] == 0
+    assert obs.metrics.counter_value("serve.circuit.opened") == 1
+    assert obs.metrics.counter_value("serve.circuit.reopened") == 1
+    assert obs.metrics.counter_value("serve.circuit.closed") == 1
+
+
+def test_circuit_release_keeps_state():
+    b = rc.CircuitBreaker("k", failure_threshold=2, cooldown_s=60)
+    b.record_failure(RuntimeError("x"))
+    b.release()  # no verdict: the count must survive
+    assert b.record_failure(RuntimeError("y"))  # second failure opens
+    assert b.state == "open"
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_lru_eviction_order():
+    c = PlanCache(capacity=2)
+    c.get_or_build("a", lambda: "A")
+    c.get_or_build("b", lambda: "B")
+    _, hit = c.get_or_build("a", lambda: "A2")  # touch a -> b is oldest
+    assert hit
+    c.get_or_build("c", lambda: "C")            # evicts b, NOT a
+    assert c.keys() == ("a", "c")
+    plan, hit = c.get_or_build("b", lambda: "B2")
+    assert not hit and plan == "B2"
+    assert c.keys() == ("c", "b")               # a evicted as oldest
+    snap = c.snapshot()
+    assert snap["evictions"] == 2 and snap["size"] == 2
+    assert obs.metrics.counter_value("serve.plan_cache.evictions") == 2
+
+
+def test_plan_cache_invalidate_prefix():
+    c = PlanCache(capacity=8)
+    base = request_key(16, 16, "f32", "r2c", "batch")
+    other = request_key(32, 32, "f32", "r2c", "batch")
+    for b in (1, 2, 4):
+        c.get_or_build(cache_key(base, b), lambda: b)
+    c.get_or_build(cache_key(other, 1), lambda: "keep")
+    assert c.invalidate_prefix(base) == 3
+    assert c.keys() == (cache_key(other, 1),)
+
+
+def test_bucket_for():
+    assert [bucket_for(n, 8) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    # a non-power-of-two cap still yields only power-of-two buckets
+    # (the vocabulary prewarm enumerates), widening the top with padding
+    assert [bucket_for(n, 6) for n in (1, 3, 5, 6)] == [1, 4, 8, 8]
+    assert bucket_for(1, 1) == 1
+    with pytest.raises(ValueError):
+        bucket_for(0, 8)
+
+
+def test_batch_chunk_clamps_to_small_buckets(devices):
+    """--batch-chunk > 1 must not make single-request (bucket-1) plans
+    unbuildable: the chunk clamps to the bucket's local batch."""
+    with Server(batch_chunk=4) as s:
+        x = _img((16, 16))
+        assert s.request(x).shape == (16, 9)   # bucket 1, chunk clamps to 1
+        assert s.prewarm((16, 16)) >= 0        # every bucket builds
+
+
+def test_worker_survives_injector_crash(devices, monkeypatch):
+    """A malformed $DFFT_FAULT_SPEC raises inside the worker's injector
+    hook; the batch must fail loudly and the worker must keep serving —
+    not die silently with futures dangling and close() hanging."""
+    with Server() as s:
+        x = _img((16, 16))
+        s.request(x)  # warm
+        monkeypatch.setenv(inject.ENV_VAR, "not a valid spec")
+        with pytest.raises(ValueError):
+            s.submit(x).result(30)
+        monkeypatch.delenv(inject.ENV_VAR)
+        assert s.request(x).shape == (16, 9)  # worker still alive
+    assert obs.metrics.counter_value("serve.batch_failures") >= 1
+
+
+# ---------------------------------------------------------------------------
+# server: correctness, coalescing, zero-recompile hits
+# ---------------------------------------------------------------------------
+
+def test_server_forward_inverse_roundtrip(devices):
+    with Server() as s:
+        x = _img((20, 26), seed=3)
+        spec = s.request(x, "r2c")
+        np.testing.assert_allclose(spec, np.fft.rfft2(x), rtol=1e-4,
+                                   atol=5e-3)
+        back = s.request(spec, "r2c", "inverse", ny=26)
+        np.testing.assert_allclose(back / (20 * 26), x, atol=1e-4)
+        # c2c too (its own plan-cache key)
+        z = _img((16, 16), seed=4).astype(np.complex64)
+        np.testing.assert_allclose(s.request(z, "c2c"), np.fft.fft2(z),
+                                   rtol=1e-4, atol=5e-3)
+        h = s.health()
+        assert h["status"] == "ok"
+        assert h["plan_cache"]["size"] == 2  # r2c fwd+inv share one plan
+
+
+def test_server_rejects_malformed():
+    with Server() as s:
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((4, 4, 4), np.float32))  # not 2D
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((4, 4), np.complex64))   # r2c fwd wants real
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((4, 4), np.float32), "c2c")
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((4, 5), np.complex64), "r2c", "inverse",
+                     ny=12)  # ny inconsistent with spectral width
+
+
+def test_coalesced_bit_identical_to_single_shot(devices):
+    imgs = [_img((24, 24), seed=i) for i in range(5)]
+    with Server(max_coalesce=1) as s1:
+        seq = [np.asarray(s1.request(x)) for x in imgs]
+    with Server(max_coalesce=8) as s2:
+        # occupy the worker with a cold build on another key so the five
+        # same-key requests are all queued when it comes free
+        s2.submit(np.zeros((8, 8), np.float32))
+        futs = [s2.submit(x) for x in imgs]
+        got = [np.asarray(f.result(60)) for f in futs]
+        assert s2.health()["counters"]["coalesced"] >= 2
+    for a, b in zip(seq, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_cache_hit_zero_recompiles(devices, monkeypatch):
+    from distributedfft_tpu.models import batched2d as b2
+    builds = []
+    orig = b2.Batched2DFFTPlan._build
+
+    def counting(self, *a, **k):
+        builds.append(1)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(b2.Batched2DFFTPlan, "_build", counting)
+    with Server() as s:
+        x = _img((18, 18))
+        s.request(x)
+        cold = len(builds)
+        assert cold >= 1
+        for i in range(4):
+            s.request(_img((18, 18), seed=i + 1))
+        assert len(builds) == cold  # warm hits: zero plan builds/lowerings
+        assert s.health()["plan_cache"]["hits"] >= 4
+        # a NEW shape is a miss and builds
+        s.request(_img((14, 14)))
+        assert len(builds) > cold
+
+
+# ---------------------------------------------------------------------------
+# deadlines + straggler injection
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_never_executes(devices, monkeypatch):
+    from distributedfft_tpu.models import batched2d as b2
+    executed = []
+    orig = b2.Batched2DFFTPlan.exec_forward
+
+    def counting(self, v):
+        executed.append(v.shape)
+        return orig(self, v)
+
+    with Server() as s:
+        x = _img((16, 16))
+        s.request(x)  # warm
+        monkeypatch.setattr(b2.Batched2DFFTPlan, "exec_forward", counting)
+        # straggler occupies the worker; the deadline of the second
+        # request expires while it queues
+        monkeypatch.setenv(inject.ENV_VAR, "server:slow:150")
+        f1 = s.submit(x)
+        f2 = s.submit(_img((16, 16), seed=9), deadline_ms=20)
+        assert f1.result(30).shape == (16, 9)
+        with pytest.raises(dl.DeadlineExceeded) as ei:
+            f2.result(30)
+        assert ei.value.detail == "queued"
+        h = s.health()
+        assert h["counters"]["deadline_expired"] == 1
+    # the expired request's payload never reached a plan: every executed
+    # stack covers exactly the surviving request(s)
+    assert executed and all(shape[0] == 1 for shape in executed)
+    assert obs.metrics.counter_value("serve.deadline_expired") == 1
+    assert obs.metrics.counter_value("inject.server_slow") >= 1
+
+
+def test_fallback_ladder_respects_ambient_deadline(monkeypatch):
+    """The ladder stops walking when the request's budget is gone: with
+    an expired ambient deadline a failing riggable plan must raise the
+    ORIGINAL error after the first attempt instead of retrying."""
+    from distributedfft_tpu.resilience import fallback
+
+    class Boom(RuntimeError):
+        pass
+
+    class FakePlan:
+        config = dfft.Config(send_method=dfft.SendMethod.RING)
+
+    calls = []
+
+    def runner():
+        def run(x):
+            calls.append(1)
+            raise Boom("always")
+        return run
+
+    with dl.scope(dl.Deadline(time.monotonic() - 0.01)):
+        with pytest.raises(Boom):
+            fallback.execute(FakePlan(), "forward", None, runner)
+    assert len(calls) == 1  # no retry: the budget was already gone
+
+
+# ---------------------------------------------------------------------------
+# admission control / shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_on_queue_full(devices, monkeypatch):
+    with Server(max_queue=2, latency_budget_ms=1e9) as s:
+        x = _img((16, 16))
+        s.request(x)  # warm
+        monkeypatch.setenv(inject.ENV_VAR, "server:slow:300")
+        futs = [s.submit(_img((16, 16), seed=i)) for i in range(2)]
+        # worker holds one batch; queue now fills at 2
+        time.sleep(0.05)
+        shed = 0
+        for i in range(6):
+            try:
+                futs.append(s.submit(_img((16, 16), seed=10 + i)))
+            except Overloaded as e:
+                assert e.reason in ("queue_full", "latency_budget")
+                assert e.queue_depth >= 2
+                shed += 1
+        assert shed >= 1
+        assert s.health()["counters"]["shed"] == shed
+    assert obs.metrics.counter_value("serve.shed") == shed
+
+
+def test_shed_on_latency_budget(devices):
+    with Server(latency_budget_ms=0.00001, max_queue=64) as s:
+        x = _img((16, 16))
+        s.request(x)  # cold build (excluded from the EMA by design)
+        s.request(x)  # warm hit: seeds the queue-delay EMA
+        assert s.health()["ema_ms"] is not None
+        # stack the queue so est delay = depth * ema > budget
+        futs = []
+        with pytest.raises(Overloaded) as ei:
+            for i in range(10):
+                futs.append(s.submit(_img((16, 16), seed=20 + i)))
+        assert ei.value.reason == "latency_budget"
+        assert ei.value.est_delay_ms > 0
+        for f in futs:
+            f.result(30)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker end-to-end (injected wire faults, shard='x' mesh)
+# ---------------------------------------------------------------------------
+
+def _chaos_server(**kw):
+    cfg = dfft.Config(guards="enforce",
+                      comm_method=dfft.CommMethod.ALL2ALL)
+    return Server(dfft.SlabPartition(8), cfg, shard="x",
+                  circuit_k=3, circuit_cooldown_s=0.25, **kw)
+
+
+def test_circuit_opens_on_injected_faults_and_recovers(devices, monkeypatch):
+    monkeypatch.setenv(inject.ENV_VAR, "wire:nan")
+    s = _chaos_server()
+    try:
+        x = _img((16, 16))
+        for _ in range(3):
+            with pytest.raises(GuardViolation):
+                s.request(x, "r2c")
+        h = s.health()
+        assert h["status"] == "degraded"
+        key = request_key(16, 16, "f32", "r2c", "x")
+        assert h["circuits"][key]["state"] == "open"
+        # open circuit: fast structured rejection at admission
+        with pytest.raises(rc.CircuitOpen) as ei:
+            s.request(x, "r2c")
+        assert ei.value.key == key
+        # the poisoned compiled plan was dropped so the probe rebuilds
+        assert s.health()["plan_cache"]["size"] == 0
+        # fault clears; after the cooldown the half-open probe re-admits
+        monkeypatch.delenv(inject.ENV_VAR)
+        time.sleep(0.3)
+        y = s.request(x, "r2c")
+        assert y.shape == (16, 9)
+        h = s.health()
+        assert h["status"] == "ok"
+        assert h["circuits"][key]["state"] == "closed"
+        assert obs.metrics.counter_value("serve.circuit.opened") == 1
+        assert obs.metrics.counter_value("serve.circuit.closed") == 1
+        assert obs.metrics.counter_value("serve.circuit.rejected") >= 1
+    finally:
+        s.close()
+
+
+def test_probe_slot_released_on_injector_crash(devices, monkeypatch):
+    """An escape between allow() and the execution envelope (malformed
+    fault spec raising inside the injector) must RELEASE the half-open
+    probe slot — a leaked slot would wedge the circuit open forever."""
+    monkeypatch.setenv(inject.ENV_VAR, "wire:nan")
+    s = _chaos_server()
+    try:
+        x = _img((16, 16))
+        for _ in range(3):
+            with pytest.raises(GuardViolation):
+                s.request(x, "r2c")  # opens the circuit
+        time.sleep(0.3)  # cooldown elapses
+        monkeypatch.setenv(inject.ENV_VAR, "totally bogus")
+        with pytest.raises(ValueError):
+            s.request(x, "r2c")      # probe batch crashes pre-envelope
+        monkeypatch.delenv(inject.ENV_VAR)
+        y = s.request(x, "r2c")      # slot was released: probe retries
+        assert y.shape == (16, 9)
+        assert s.health()["status"] == "ok"
+    finally:
+        s.close()
+
+
+def test_circuit_probe_failure_reopens(devices, monkeypatch):
+    monkeypatch.setenv(inject.ENV_VAR, "wire:bitflip")
+    s = _chaos_server()
+    try:
+        x = _img((16, 16))
+        for _ in range(3):
+            with pytest.raises(GuardViolation):
+                s.request(x, "r2c")
+        assert s.health()["status"] == "degraded"
+        time.sleep(0.3)  # cooldown elapses, fault still active
+        with pytest.raises(GuardViolation):
+            s.request(x, "r2c")  # the probe executes... and fails
+        key = request_key(16, 16, "f32", "r2c", "x")
+        assert s.health()["circuits"][key]["state"] == "open"
+        assert obs.metrics.counter_value("serve.circuit.reopened") == 1
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# drain + event-log evidence
+# ---------------------------------------------------------------------------
+
+def test_graceful_drain_and_event_log(devices, tmp_path):
+    obs.enable(str(tmp_path))
+    try:
+        s = Server()
+        x = _img((16, 16))
+        s.request(x)  # warm
+        futs = [s.submit(_img((16, 16), seed=i)) for i in range(4)]
+        s.close(drain=True)  # queued work FINISHES
+        for f in futs:
+            assert f.result(0.0).shape == (16, 9)  # already resolved
+        with pytest.raises(ServerClosed):
+            s.submit(x)
+        assert s.health()["status"] == "stopped"
+    finally:
+        obs.reset_enablement()
+    n = obs.validate_events_dir(str(tmp_path))
+    assert n > 0
+    names = set()
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("events-") and fn.endswith(".jsonl"):
+            with open(tmp_path / fn) as f:
+                for ln in f:
+                    if ln.strip():
+                        names.add(json.loads(ln)["name"])
+    for want in ("serve.start", "serve.batch", "serve.drain", "serve.stop"):
+        assert want in names, f"missing {want} in {sorted(names)}"
+
+
+def test_close_without_drain_rejects_queued(devices, monkeypatch):
+    with Server() as s:
+        x = _img((16, 16))
+        s.request(x)  # warm
+        monkeypatch.setenv(inject.ENV_VAR, "server:slow:200")
+        futs = [s.submit(_img((16, 16), seed=i)) for i in range(3)]
+        time.sleep(0.02)  # let the worker take the first batch
+        s.close(drain=False)
+        outcomes = []
+        for f in futs:
+            try:
+                f.result(30)
+                outcomes.append("ok")
+            except ServerClosed:
+                outcomes.append("closed")
+        # the in-flight batch finished; anything still queued was rejected
+        assert "ok" in outcomes or "closed" in outcomes
+        assert s.state == "stopped"
+
+
+# ---------------------------------------------------------------------------
+# load generator
+# ---------------------------------------------------------------------------
+
+def test_serve_load_measures_and_classifies(devices):
+    with Server(latency_budget_ms=10_000) as s:
+        out = serve_load(s, rate_hz=40, n_requests=20,
+                         shapes=((16, 16),), seed=2)
+    assert out["offered"] == 20
+    assert out["outcomes"]["ok"] == out["completed"] > 0
+    assert out["p50_ms"] is not None and out["p99_ms"] >= out["p50_ms"]
+    assert out["achieved_fps"] > 0
+
+
+def test_serve_load_counts_rejections(devices):
+    s = Server(latency_budget_ms=10_000)
+    s.close()
+    out = serve_load(s, rate_hz=100, n_requests=5, shapes=((16, 16),),
+                     warmup=0)
+    assert out["outcomes"]["closed"] == 5 and out["completed"] == 0
+
+
+def test_serve_load_arg_validation(devices):
+    with Server() as s:
+        with pytest.raises(ValueError):
+            serve_load(s, rate_hz=1.0)  # neither duration nor count
+        with pytest.raises(ValueError):
+            serve_load(s, rate_hz=1.0, duration_s=1, n_requests=1)

@@ -73,6 +73,7 @@ BUDGET_S = 450               # parent wall-clock; driver's outer limit is >480
 PROBE_TIMEOUT_S = 180        # re-probe ceiling (first probe rides the budget)
 MESH_TIMEOUT_S = 300
 SERVE_TIMEOUT_S = 90         # serving-layer saturation bench (CPU, bounded)
+SOLVERS_TIMEOUT_S = 75       # solvers suite bench (CPU, bounded; ISSUE 9)
 MEASURE_RESERVE_S = 120      # budget step 3 needs after a successful probe
 # Default sweep covers the BASELINE metric's own sizes (VERDICT r3 item 7:
 # the artifact must re-measure them, not rely on committed CSVs). Headline
@@ -919,6 +920,126 @@ def _child_serve(deadline_s: int = 90) -> int:
     return 0
 
 
+def _child_solvers(deadline_s: int = SOLVERS_TIMEOUT_S) -> int:
+    """Solvers-suite bench (ISSUE 9; CPU mesh, tunnel-immune): (1) the
+    Navier-Stokes RK4 step time — 2D vorticity ensemble on the batched-2D
+    plan and a small 3D slab solve — the repeated-forward/inverse
+    steady-state workload ROADMAP item 4 names; (2) Bluestein vs
+    zero-padding throughput for a prime-size transform: the chirp-z
+    backend at the EXACT length against the two things users otherwise
+    do — run the prime length through the generic xla path, or pad the
+    DATA to the next smooth size (which changes the transform, but is
+    the classic workaround whose cost the race should quote)."""
+    from distributedfft_tpu.parallel.mesh import force_cpu_devices
+    force_cpu_devices(8)
+
+    import numpy as np
+
+    out = {}
+
+    def _handler(signum, frame):
+        raise TimeoutError("solvers child deadline")
+    signal.signal(signal.SIGALRM, _handler)
+    signal.alarm(max(20, deadline_s - 10))
+    try:
+        import jax
+
+        from distributedfft_tpu import Config, GlobalSize, SlabPartition
+        from distributedfft_tpu.models.slab import SlabFFTPlan
+        from distributedfft_tpu.solvers.navier_stokes import (
+            NavierStokes3D, taylor_green_3d)
+        from distributedfft_tpu.testing.workloads import (flops_ns2d_step,
+                                                          ns2d_chain)
+        rng = np.random.default_rng(0)
+
+        def _median_ms(fn, x, reps: int = 5):
+            fn(x)  # compile + warm
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                ts.append((time.perf_counter() - t0) * 1e3)
+            return sorted(ts)[len(ts) // 2]
+
+        # NS 2D step time: k-step scan chain, per-step = (t_K - t_1)/(K-1)
+        # (the chaintimer pair-difference convention, so compile/dispatch
+        # overheads cancel).
+        b, n, k = 4, int(os.environ.get("DFFT_BENCH_NS_N", "64")), 8
+        f1, _ = ns2d_chain(1, b, n, shard="x",
+                           partition=SlabPartition(8))
+        fk, _ = ns2d_chain(k, b, n, shard="x",
+                           partition=SlabPartition(8))
+        w0 = rng.random((b, n, n), dtype=np.float32)
+        t1 = _median_ms(lambda v: f1(v), w0)
+        tk = _median_ms(lambda v: fk(v), w0)
+        step_ms = max((tk - t1) / (k - 1), 0.0)
+        out["ns2d"] = {
+            "batch": b, "n": n, "steps": k,
+            "step_ms": round(step_ms, 3),
+            "gflops": round(flops_ns2d_step(b, n) / (step_ms * 1e-3) / 1e9,
+                            2) if step_ms > 0 else None,
+            "note": "RK4 step = 20 distributed fwd/inv transforms "
+                    "(shard='x', 8-dev CPU mesh); pair-difference timing"}
+
+        # NS 3D smoke number: one WHOLE 1-step solve on a small slab cube
+        # — 36 RHS transforms (4 RK4 stages x 9) PLUS the entry/exit
+        # conversions (3 fwd + Leray projection in, 3 inv out) and the
+        # call dispatch. Deliberately NOT named step_ms: it is a
+        # solve-invocation time, not a pair-difference-corrected per-step
+        # cost like the ns2d field, and the two must not be compared.
+        n3 = int(os.environ.get("DFFT_BENCH_NS3_N", "32"))
+        plan3 = SlabFFTPlan(GlobalSize(n3, n3, n3), SlabPartition(8),
+                            Config(fft_backend="matmul"))
+        ns3 = NavierStokes3D(plan3, 1e-3)
+        sfn3 = jax.jit(ns3.solve_fn(1, 1e-3))
+        u0 = taylor_green_3d(n3, dtype=np.float32)
+        out["ns3d"] = {
+            "n": n3,
+            "solve1_ms": round(_median_ms(sfn3, u0), 3),
+            "note": "whole 1-step solve_fn call (entry/exit transforms "
+                    "included) — not comparable to ns2d.step_ms"}
+
+        # Bluestein vs zero-padding: prime-size batched 1D-per-axis 2D
+        # transform (np-correct length) vs the padded smooth alternative.
+        p = int(os.environ.get("DFFT_BENCH_PRIME", "251"))
+        from distributedfft_tpu.ops import fft as lf
+        from distributedfft_tpu.ops.bluestein import chirp_length, good_size
+        stack = rng.random((32, p, p), dtype=np.float32)
+
+        def _fwd2(backend):
+            def fn(x):
+                c = lf.rfft(x, axis=-1, backend=backend)
+                return lf.fft(c, axis=-2, backend=backend)
+            return jax.jit(fn)
+
+        ms_blue = _median_ms(_fwd2("bluestein"), stack)
+        ms_xla = _median_ms(_fwd2("xla"), stack)
+        g = good_size(p)
+        padded = np.zeros((32, g, g), dtype=np.float32)
+        padded[:, :p, :p] = stack
+        ms_pad = _median_ms(_fwd2("xla"), padded)
+        out["bluestein"] = {
+            "prime": p, "chirp_length": chirp_length(p),
+            "padded_smooth": g,
+            "bluestein_ms": round(ms_blue, 3),
+            "xla_generic_ms": round(ms_xla, 3),
+            "zero_padded_smooth_ms": round(ms_pad, 3),
+            "note": "batched 2D forward (32 planes) at the EXACT prime "
+                    "length via chirp-z vs xla's generic path, and the "
+                    "semantics-changing pad-to-smooth workaround "
+                    "(fft_backend='auto' races these per shape)"}
+    except TimeoutError as e:
+        out["partial"] = True
+        out["error"] = str(e)
+    except Exception as e:  # noqa: BLE001 — still print what was measured
+        out["partial"] = True
+        out["error"] = f"{type(e).__name__}: {e}"
+    _fold_obs_metrics(out)
+    signal.alarm(0)
+    print(json.dumps(out))
+    return 0
+
+
 def _direct_plan_override(backend: str, n: int):
     """(MXUSettings, artifact note) for sizes where the ALL-DIRECT matmul
     plan is the measured winner; (None, None) otherwise.
@@ -1148,6 +1269,20 @@ def main() -> int:
         diags.append("serve: skipped, no budget above the measurement "
                      "reserve")
 
+    # 2c. Solvers-suite bench (ISSUE 9): CPU-only, short and bounded —
+    #     NS step time + Bluestein-vs-padded throughput; same budget
+    #     posture as the serve child.
+    solvers = None
+    solvers_grant = min(SOLVERS_TIMEOUT_S, remaining() - MEASURE_RESERVE_S)
+    if solvers_grant >= 30:
+        solvers, d = _run_child("solvers", solvers_grant,
+                                extra=(int(solvers_grant),))
+        if d:
+            diags.append(d)
+    else:
+        diags.append("solvers: skipped, no budget above the measurement "
+                     "reserve")
+
     # Collect the probe with everything left above the measurement
     # reserve (it has already been waiting the whole mesh phase).
     tpu = None
@@ -1336,6 +1471,10 @@ def main() -> int:
         # latency and the offered-load sweep (p50/p99, FFTs/sec, shed,
         # plan-cache hit rate) — ROADMAP item 2's decision measurement.
         result["serve"] = serve
+    if solvers:
+        # Solvers-suite record (ISSUE 9): NS RK4 step time (2D ensemble +
+        # 3D cube) and Bluestein-vs-zero-padded prime-size throughput.
+        result["solvers"] = solvers
     if (tpu or {}).get("obs_metrics"):
         result["obs_metrics_tpu"] = tpu["obs_metrics"]
     if (tpu or {}).get("partial"):
@@ -1403,6 +1542,9 @@ if __name__ == "__main__":
         if name == "serve":
             sys.exit(_child_serve(int(sys.argv[3]) if len(sys.argv) > 3
                                   else SERVE_TIMEOUT_S))
+        if name == "solvers":
+            sys.exit(_child_solvers(int(sys.argv[3]) if len(sys.argv) > 3
+                                    else SOLVERS_TIMEOUT_S))
         print(f"unknown child {name}", file=sys.stderr)
         sys.exit(2)
     try:

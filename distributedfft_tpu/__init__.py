@@ -53,7 +53,14 @@ from .models.batched2d import Batched2DFFTPlan
 from .models.pencil import PencilFFTPlan
 from .models.slab import SlabFFTPlan
 from .resilience import GuardViolation
-from .solvers.poisson import PoissonSolver
+from .solvers import (
+    NavierStokes2D,
+    NavierStokes3D,
+    PoissonSolver,
+    SpectralConvolver,
+    make_convolver,
+    make_solver,
+)
 
 __all__ = [
     "AUTO", "CommMethod", "Config", "FFTNorm", "GlobalSize", "PartitionDims",
@@ -61,8 +68,10 @@ __all__ = [
     "block_sizes", "block_starts", "padded_extent", "parse_comm_method",
     "PENCIL_AXES", "SLAB_AXIS", "best_pencil_grid", "make_pencil_mesh",
     "make_slab_mesh", "Batched2DFFTPlan", "DistFFTPlan", "GuardViolation",
-    "PencilFFTPlan", "PoissonSolver", "SlabFFTPlan",
-    "global_from_local", "maybe_initialize", "process_local_slices",
+    "NavierStokes2D", "NavierStokes3D", "PencilFFTPlan", "PoissonSolver",
+    "SlabFFTPlan", "SpectralConvolver", "global_from_local",
+    "make_convolver", "make_solver", "maybe_initialize",
+    "process_local_slices",
 ]
 
 __version__ = "0.1.0"

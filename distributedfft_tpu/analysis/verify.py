@@ -156,6 +156,14 @@ def iter_combos(args: Any, ndev: int) -> Iterator[Dict[str, Any]]:
         yield dict(family="slab", rendering="none", sequence="ZY_Then_X",
                    wire="native", guards="off", direction="forward",
                    single=True)
+        # The Bluestein combo (ISSUE 9): a PRIME r2c axis through the
+        # chirp-z backend — the census / forbidden-op / payload pins must
+        # hold on the chirp path too (the chirp's internal smooth FFTs
+        # and host-constant kernel spectra stay strictly local: exactly
+        # one all-to-all, native wire stays bf16-free, payload unchanged).
+        yield dict(family="slab", rendering="bluestn", sequence="ZY_Then_X",
+                   wire="native", guards="off", direction="forward",
+                   bluestein=True)
     if "batched" in families:
         yield dict(family="batched", rendering="none", sequence="",
                    wire="native", guards="off", direction="forward",
@@ -169,7 +177,14 @@ def run_combo(combo: Dict[str, Any], ndev: int,
 
     from . import contracts, hloscan, jaxprlint
 
-    if combo.get("single"):
+    if combo.get("bluestein"):
+        # Prime (non-smooth) z axis: 19 -> halved 10; x stays the uneven
+        # gate extent so the padding machinery is covered alongside the
+        # chirp path.
+        plan, dims = dfft.SlabFFTPlan(
+            dfft.GlobalSize(20, 16, 19), pm.SlabPartition(ndev),
+            dfft.Config(fft_backend="bluestein", use_wisdom=False)), 3
+    elif combo.get("single"):
         plan, dims = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
                                       pm.SlabPartition(1),
                                       dfft.Config(use_wisdom=False)), 3

@@ -31,10 +31,12 @@ row measured under those toggles must not be fed to ``roofline_rows``
 
 from __future__ import annotations
 
+import math
 import os
 import re
-from typing import Optional
+from typing import Optional, Tuple
 
+from ..ops.bluestein import chirp_length, is_smooth
 from ..ops.mxu_fft import DIRECT_MAX, _R2_BASE, _split
 
 V5E_PEAK_BF16_TFLOPS = 197.0
@@ -109,6 +111,49 @@ def macs_c2r_axis(n: int, direct_max: int = DIRECT_MAX, *,
         return 2.0 * n_out
     return macs_c2c_axis(n, direct_max, radix2=radix2,
                          complex_mults=complex_mults)
+
+
+# ---------------------------------------------------------------------------
+# Bluestein (chirp-z) honesty: non-smooth axes
+# ---------------------------------------------------------------------------
+#
+# The nominal 2.5·N·log2 N FLOP model (BASELINE.md §Derived, quoted by the
+# CSVs and `flops_roundtrip_3d`) silently assumes every axis is 5-smooth.
+# A Bluestein-padded axis actually executes TWO smooth transforms at the
+# padded chirp length m = chirp_length(n) (>= 2n-1, next power of two)
+# plus O(m) chirp multiplies per pass, and the matmul backend off the
+# chirp path executes a dense O(n^2) contraction — so for non-smooth axes
+# the honest model must say so instead of quoting the smooth-size number.
+
+
+def nominal_flops_axis(n: int) -> float:
+    """Textbook per-element flops of ONE smooth-length-n axis pass
+    (2.5·log2 n per element, the CSVs' nominal convention)."""
+    return 2.5 * math.log2(float(n))
+
+
+def bluestein_flops_axis(n: int) -> float:
+    """Per-element flops one chirp-z pass of a non-smooth length-n axis
+    actually needs: two length-m smooth FFTs amortized over n elements
+    (the kernel spectrum is precomputed) plus the three O(1)-per-element
+    chirp/pointwise multiplies (6 real flops each as complex mults)."""
+    m = chirp_length(n)
+    return 2.0 * 2.5 * m * math.log2(float(m)) / float(n) + 3.0 * 6.0
+
+
+def bluestein_axis_report(n: int) -> Tuple[int, float]:
+    """(padded chirp length m, flop overhead factor vs a natively smooth
+    axis of the same length) — the pair dfft-explain quotes so a
+    prime-size plan's roofline is honest rather than silently wrong.
+    Smooth lengths report (n, 1.0): the backend delegates them."""
+    if is_smooth(n):
+        return n, 1.0
+    return chirp_length(n), bluestein_flops_axis(n) / nominal_flops_axis(n)
+
+
+def nonsmooth_axes(shape) -> list:
+    """The distinct non-5-smooth axis lengths of a shape (sorted)."""
+    return sorted({int(n) for n in shape if not is_smooth(int(n))})
 
 
 # ---------------------------------------------------------------------------

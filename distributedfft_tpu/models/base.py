@@ -29,6 +29,28 @@ from ..resilience import fallback, guards
 from ..utils import wisdom
 
 
+def notice_axis_smoothness(kind: str, axes_lengths, config) -> None:
+    """Arbitrary-size axis support, the advisory half: every family
+    accepts any axis length (padding handles mesh divisibility), but a
+    non-5-smooth length silently leaves the fast path of the matmul /
+    pallas backends (``mxu_fft._split`` degrades a prime to one dense
+    O(n^2) contraction). Surface that at plan construction — with the
+    fix (``fft_backend="bluestein"``, the chirp-z backend that keeps any
+    length at O(n log n)) — instead of letting it show up only as a
+    mystery slowdown. The xla/bluestein backends handle every length;
+    no notice there."""
+    from .. import obs
+    from ..ops.bluestein import is_smooth
+    rough = sorted({int(n) for n in axes_lengths if not is_smooth(int(n))})
+    if rough and config.fft_backend in ("matmul", "matmul-r2", "pallas"):
+        obs.notice(
+            f"{kind} plan: non-smooth axis length(s) {rough} fall off the "
+            f"{config.fft_backend} fast path (dense O(n^2) per axis); "
+            "fft_backend='bluestein' keeps them O(n log n)",
+            name="plan.nonsmooth_axes", kind=kind, lengths=rough,
+            backend=config.fft_backend)
+
+
 def _with_pad(pure, logical_shape, padded_shape):
     """Wrap a pure pipeline so logical-shaped input is zero-padded to the
     mesh-divisible padded shape (the traced analog of the exec_* padding
@@ -183,6 +205,59 @@ class DistFFTPlan:
         """Key components of this plan's wisdom entry (the fallback
         ladder's demotion stamp targets the exact cell that failed)."""
         raise NotImplementedError
+
+    # -- solver protocol ---------------------------------------------------
+    # The spectral-application suite (``solvers/``) drives plans through
+    # this transform-agnostic surface only: forward/inverse regardless of
+    # the plan's transform family, which axes the transform covers, and
+    # where the R2C halving sits. Implemented here for the DistFFTPlan
+    # hierarchy (slab/pencil); ``Batched2DFFTPlan`` — outside the
+    # hierarchy — honors the identical contract, so a solver written
+    # against it runs on every family unchanged.
+
+    @property
+    def transform_axes(self) -> Tuple[int, ...]:
+        """Axes the transform covers (3D plans: all three; the batched-2D
+        plan reports (1, 2) — its axis 0 is a pure batch dimension)."""
+        return (0, 1, 2)
+
+    @property
+    def transform_size(self) -> int:
+        """Product of the logical extents over the TRANSFORMED axes — the
+        N of this plan's DFT (solvers derive normalization scales from
+        it; for a batched-2D plan it is nx*ny, not the stack volume)."""
+        dims = self.input_shape
+        out = 1
+        for a in self.transform_axes:
+            out *= int(dims[a])
+        return out
+
+    @property
+    def spectral_halved_axis(self) -> Optional[int]:
+        """Index of the ``n//2+1``-halved spectral axis, or None for C2C
+        plans (no halving)."""
+        if getattr(self, "transform", "r2c") == "c2c":
+            return None
+        return self._halved_axis_index()
+
+    def _halved_axis_index(self) -> int:
+        """R2C halved axis of this family (pencil halves z; the slab
+        engine overrides per sequence)."""
+        return 2
+
+    def exec_fwd(self, x):
+        """Forward transform through the plan's own transform family
+        (r2c -> ``exec_r2c``, c2c -> ``exec_c2c``) — the solver suite's
+        uniform entry point."""
+        if getattr(self, "transform", "r2c") == "c2c":
+            return self.exec_c2c(x)
+        return self.exec_r2c(x)
+
+    def exec_inv(self, c):
+        """Inverse transform (see ``exec_fwd``)."""
+        if getattr(self, "transform", "r2c") == "c2c":
+            return self.exec_c2c_inv(c)
+        return self.exec_c2r(c)
 
     # -- pure pipelines (compose under user transforms) --------------------
 

@@ -38,7 +38,7 @@ from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   pad_axis_to, ring_transpose, slice_axis_to,
                                   split_axis_chunks, wire_gspmd_stages)
 from ..utils import wisdom
-from .base import _with_pad, jit_stages
+from .base import _with_pad, jit_stages, notice_axis_smoothness
 
 
 class Batched2DFFTPlan:
@@ -133,6 +133,7 @@ class Batched2DFFTPlan:
         self._inv_unguarded = None
         self._fwd_pure = None
         self._inv_pure = None
+        notice_axis_smoothness("batched2d", (nx, ny), self.config)
         obs.event("plan.created", kind="batched2d", shard=shard,
                   transform=transform, shape=[batch, nx, ny], ranks=P,
                   batch_chunk=batch_chunk,
@@ -233,6 +234,32 @@ class Batched2DFFTPlan:
         if self._inv is None:
             self._inv = self._build(forward=False)
         return self._inv
+
+    # -- solver protocol (models/base.py contract; this plan sits outside
+    #    the DistFFTPlan hierarchy but honors the identical surface) -------
+
+    @property
+    def transform_axes(self) -> Tuple[int, ...]:
+        """The 2D transform covers (x, y); axis 0 is a pure batch
+        dimension the solver suite broadcasts its symbols over."""
+        return (1, 2)
+
+    @property
+    def transform_size(self) -> int:
+        """N of the per-plane 2D transform (nx*ny; the batch axis carries
+        no normalization — ``DistFFTPlan.transform_size`` contract)."""
+        return self.nx * self.ny
+
+    @property
+    def spectral_halved_axis(self) -> Optional[int]:
+        return None if self.transform == "c2c" else 2
+
+    def exec_fwd(self, x):
+        """Solver-protocol forward (``DistFFTPlan.exec_fwd`` contract)."""
+        return self.exec_forward(x)
+
+    def exec_inv(self, c):
+        return self.exec_inverse(c)
 
     # -- resilience hooks (guards + fallback ladder) -----------------------
 

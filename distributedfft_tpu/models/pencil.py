@@ -60,7 +60,7 @@ from ..parallel.transpose import (WIRE_NATIVE, all_to_all_transpose,
                                   wire_decode, wire_encode)
 from ..resilience import inject
 from ..utils import wisdom
-from .base import DistFFTPlan, _with_pad
+from .base import DistFFTPlan, _with_pad, notice_axis_smoothness
 
 P1_AXIS, P2_AXIS = PENCIL_AXES
 
@@ -120,6 +120,7 @@ class PencilFFTPlan(DistFFTPlan):
         # The depth the wisdom entry was resolved under (the fallback
         # ladder's demotion stamp must target the exact cell).
         self._wisdom_dims = dims
+        notice_axis_smoothness("pencil", g.shape, self.config)
         obs.event("plan.created", kind="pencil", transform=transform,
                   shape=list(g.shape), grid=[self.p1, self.p2],
                   comm=self.config.comm_method.value,

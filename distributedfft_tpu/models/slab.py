@@ -65,7 +65,7 @@ from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
                                   pad_axis_to, ring_transpose, slice_axis_to,
                                   split_axis_chunks, wire_gspmd_stages)
 from ..utils import wisdom
-from .base import DistFFTPlan, _with_pad
+from .base import DistFFTPlan, _with_pad, notice_axis_smoothness
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +142,7 @@ class SlabFFTPlan(DistFFTPlan):
             out = [None, None, None]
             out[self._seq.split_axis] = SLAB_AXIS
             self._out_spec = PartitionSpec(*out)
+        notice_axis_smoothness("slab", g.shape, self.config)
         obs.event("plan.created", kind="slab", sequence=self.sequence.value,
                   transform=transform, shape=list(g.shape), ranks=P,
                   comm=self.config.comm_method.value,
@@ -264,6 +265,11 @@ class SlabFFTPlan(DistFFTPlan):
             c = self.pad_spectral(c)
         from ..resilience import fallback
         return fallback.execute(self, "inverse", c, self._get_c2r)
+
+    def _halved_axis_index(self) -> int:
+        """Solver-protocol hook: the sequence's R2C axis carries the
+        halving (y for Y_Then_ZX, z otherwise)."""
+        return self._seq.r2c_axis
 
     # -- resilience hooks (guards + fallback ladder) -----------------------
 

@@ -261,15 +261,34 @@ def _serve_lines(args, kind: str, plan, cfg) -> list:
 
 def _roofline_lines(args, kind: str, backend: str) -> list:
     """Roofline expectation for the explained workload (cube / batched-2D
-    only — the shapes the MAC model covers)."""
+    only — the shapes the MAC model covers). Non-smooth axes get the
+    HONEST Bluestein accounting (padded chirp length + overhead factor)
+    instead of a silently-wrong smooth-size number."""
     from ..evalkit import roofline as rl
     from ..testing.workloads import flops_batched2d, flops_roundtrip_3d
     nx, ny, nz = args.input_dim_x, args.input_dim_y, args.input_dim_z
     lines = []
+    tshape = (nx, ny) if kind == "batched" else (nx, ny, nz)
+    rough = rl.nonsmooth_axes(tshape)
+    for n in rough:
+        m, over = rl.bluestein_axis_report(n)
+        lines.append(
+            f"  non-smooth axis {n}: no native fast path — bluestein "
+            f"chirp length {m} (padded), ~{over:.1f}x the flops of a "
+            f"smooth axis per pass"
+            + ("" if backend == "bluestein" else
+               f"; backend {backend} runs it "
+               + ("as a dense O(n^2) contraction"
+                  if backend.startswith("matmul") or backend == "pallas"
+                  else "through XLA's generic expansion")
+               + " (fft_backend='bluestein' takes the chirp path)"))
+    if rough:
+        lines.append("  (the nominal 2.5·N·log2 N model below assumes "
+                     "smooth axes; scale by the factors above)")
     if kind == "batched":
         if nx != ny:
-            return ["  (batched roofline model needs square planes; "
-                    "skipped)"]
+            return lines + ["  (batched roofline model needs square "
+                            "planes; skipped)"]
         nominal = flops_batched2d(nz, nx, ny)
         mxu4 = rl.mxu_flops_batched2d(nz, nx)
         mxu3 = rl.mxu_flops_batched2d(nz, nx, complex_mults=3)
@@ -280,8 +299,8 @@ def _roofline_lines(args, kind: str, backend: str) -> list:
         mxu3 = rl.mxu_flops_roundtrip_3d(nx, complex_mults=3)
         what = f"{nx}^3 roundtrip"
     else:
-        return ["  (MXU MAC model covers cubes and square batched planes "
-                "only; skipped for this shape)"]
+        return lines + ["  (MXU MAC model covers cubes and square batched "
+                        "planes only; skipped for this shape)"]
     lines.append(f"  nominal FFT flops ({what}): {nominal / 1e9:.2f} GF "
                  "(2.5·N·log2 N per direction)")
     lines.append(f"  matmul-backend MXU flops: {mxu3 / 1e9:.2f}-"

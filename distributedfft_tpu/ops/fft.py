@@ -16,8 +16,12 @@ systolic array; ``"matmul-r2"`` is the same backend with radix-2 DIF
 splitting of the C2C stages down to MXU-depth matmuls (measured slower on
 v5e at 256^3 — see ``mxu_fft.MXUSettings.radix2`` — raced for completeness);
 ``"pallas"`` runs the same four-step with hand-written Pallas kernels
-fusing the twiddle epilogue into the DFT matmul (``ops/pallas_fft.py``).
-Selected plan-wide via ``Config.fft_backend``.
+fusing the twiddle epilogue into the DFT matmul (``ops/pallas_fft.py``);
+``"bluestein"`` is the arbitrary-size backend (``ops/bluestein.py``):
+5-smooth axes delegate to the XLA expansion bit-identically, while prime /
+non-smooth lengths run the chirp-z identity at O(n log n) instead of
+falling off every fast path (the matmul four-step degrades to a dense
+O(n^2) contraction there). Selected plan-wide via ``Config.fft_backend``.
 """
 
 from __future__ import annotations
@@ -30,7 +34,7 @@ import jax.numpy as jnp
 
 from ..params import FFTNorm
 
-BACKENDS = ("xla", "matmul", "matmul-r2", "pallas")
+BACKENDS = ("xla", "matmul", "matmul-r2", "pallas", "bluestein")
 
 
 def _mxu():
@@ -41,6 +45,11 @@ def _mxu():
 def _pallas():
     from . import pallas_fft
     return pallas_fft
+
+
+def _bluestein():
+    from . import bluestein
+    return bluestein
 
 
 def validate_backend(backend: str) -> str:
@@ -57,6 +66,8 @@ def _impl(backend: str):
         return _mxu()
     if b == "pallas":
         return _pallas()
+    if b == "bluestein":
+        return _bluestein()
     return None
 
 

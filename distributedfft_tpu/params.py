@@ -353,13 +353,19 @@ class Config:
     ``fft_backend`` selects the local-transform implementation: ``"xla"``
     (XLA's FFT expansion), ``"matmul"`` (MXU four-step DFT matmuls,
     ``ops/mxu_fft.py``), ``"matmul-r2"`` (same with radix-2 DIF splitting
-    down to MXU-depth matmuls), or ``"pallas"`` (Pallas kernels fusing the
-    four-step twiddle into the DFT matmul, ``ops/pallas_fft.py``) — the TPU
-    analog of the reference's cuFFT-plan choice at L0
-    (``include/cufft.hpp:23-61``). ``fft_backend="auto"`` defers the choice
-    to measurement: plan construction consults the persistent wisdom store
+    down to MXU-depth matmuls), ``"pallas"`` (Pallas kernels fusing the
+    four-step twiddle into the DFT matmul, ``ops/pallas_fft.py``), or
+    ``"bluestein"`` (``ops/bluestein.py``: chirp-z for arbitrary —
+    prime, non-smooth — axis lengths at O(n log n); 5-smooth axes
+    delegate to the XLA expansion bit-identically, so it costs nothing
+    where the fast path already applies) — the TPU analog of the
+    reference's cuFFT-plan choice at L0 (``include/cufft.hpp:23-61``).
+    ``fft_backend="auto"`` defers the choice to measurement: plan
+    construction consults the persistent wisdom store
     (``utils/wisdom.py``; path from ``wisdom_path`` -> ``$DFFT_WISDOM``),
-    races the backends on a miss and records the winner. ``comm_method=
+    races the backends on a miss (the bluestein candidate joins exactly
+    when the shape has a non-smooth axis — it would duplicate "xla"
+    otherwise) and records the winner. ``comm_method=
     "auto"`` does the same for the whole comm x send x opt x streams-chunks
     variant, the RING ring rendering included (ignoring the explicit
     ``send_method``/``opt`` fields — the race owns them). ``use_wisdom=False`` (CLI ``--no-wisdom``) never

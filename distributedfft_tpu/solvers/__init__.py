@@ -1,0 +1,77 @@
+"""``solvers/`` — the spectral application suite on the distributed core.
+
+The reference library's entire upper layer exists to prove the
+distributed FFT on real spectral applications (SURVEY L5, its testcase
+executables); this package is that product surface, grown from the
+original Poisson workload into a suite (ROADMAP item 4). Every solver
+drives plans through the transform-agnostic solver protocol of
+``models/base.py`` (``exec_fwd``/``exec_inv``, ``forward_fn``/
+``inverse_fn``, ``transform_axes``, ``spectral_halved_axis``), so the
+same solver runs on slab, pencil, and batched-2D plans unchanged:
+
+* :class:`PoissonSolver` — FFT-diagonalized ∇²u = f; periodic,
+  Dirichlet and Neumann boxes (via the R2R extensions).
+* :class:`NavierStokes2D` / :class:`NavierStokes3D` — pseudo-spectral
+  incompressible Navier-Stokes (RK4, 2/3-rule dealiasing),
+  differentiable end to end.
+* :class:`SpectralConvolver` — large-kernel linear convolution /
+  correlation with correct zero-padding (images batched through the
+  batched-2D stacked execution, volumes through slab/pencil).
+* ``dct`` / ``dst`` (+ ``idct``/``idst``/``dctn``/``dstn``) — scipy-
+  convention real-to-real transforms via the R2C machinery
+  (``solvers/r2r.py``).
+
+``make_solver(kind, plan, ...)`` is the uniform entry point.
+"""
+
+from __future__ import annotations
+
+from .convolve import SpectralConvolver, conv_shape, make_convolver
+from .navier_stokes import (NavierStokes2D, NavierStokes3D, taylor_green_2d,
+                            taylor_green_3d)
+from .poisson import PoissonSolver
+from .r2r import dct, dctn, dst, dstn, idct, idst
+
+_KINDS = ("poisson", "navier_stokes", "convolve")
+
+
+def make_solver(kind: str, plan, **kwargs):
+    """Build a solver of ``kind`` over ``plan``:
+
+    * ``"poisson"`` -> :class:`PoissonSolver` (kwargs: ``lengths``,
+      ``mode``, ``bc``);
+    * ``"navier_stokes"`` -> :class:`NavierStokes2D` or
+      :class:`NavierStokes3D`, dispatched on the plan's
+      ``transform_axes`` rank (kwargs: ``viscosity`` [required],
+      ``lengths``);
+    * ``"convolve"`` -> :class:`SpectralConvolver` (kwargs: ``kernel``
+      [required], ``image_shape`` [required], ``mode``, ``correlate``).
+    """
+    key = str(kind).strip().lower().replace("-", "_")
+    if key == "poisson":
+        return PoissonSolver(plan, **kwargs)
+    if key in ("navier_stokes", "ns"):
+        if "viscosity" not in kwargs:
+            raise TypeError("make_solver('navier_stokes', ...) requires "
+                            "viscosity=")
+        nd = len(tuple(plan.transform_axes))
+        cls = {2: NavierStokes2D, 3: NavierStokes3D}.get(nd)
+        if cls is None:
+            raise ValueError(f"no Navier-Stokes solver for a {nd}D-transform "
+                             "plan")
+        return cls(plan, **kwargs)
+    if key == "convolve":
+        if "kernel" not in kwargs or "image_shape" not in kwargs:
+            raise TypeError("make_solver('convolve', ...) requires kernel= "
+                            "and image_shape=")
+        return SpectralConvolver(plan, kwargs.pop("kernel"),
+                                 kwargs.pop("image_shape"), **kwargs)
+    raise ValueError(f"unknown solver kind {kind!r} (choose from {_KINDS})")
+
+
+__all__ = [
+    "NavierStokes2D", "NavierStokes3D", "PoissonSolver",
+    "SpectralConvolver", "conv_shape", "dct", "dctn", "dst", "dstn",
+    "idct", "idst", "make_convolver", "make_solver", "taylor_green_2d",
+    "taylor_green_3d",
+]

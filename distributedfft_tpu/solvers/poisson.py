@@ -1,17 +1,23 @@
 """FFT-diagonalized Poisson solver — BASELINE config #5
 ("3D Poisson solve (FFT-diagonalized Laplacian) 2048^3").
 
-Solves the periodic Poisson problem  ∇²u = f  by forward transform, division
-by the Laplacian symbol, inverse transform — the user-facing version of the
+Solves the Poisson problem  ∇²u = f  by forward transform, division by the
+Laplacian symbol, inverse transform — the user-facing version of the
 reference's testcase-4 Laplacian validation (its ``derivativeCoefficients``
 kernel, ``tests/src/slab/random_dist_default.cu:71-119``, applies exactly
 this operator forward).
 
-The whole solve (symbol multiply included) runs in the plan's distributed
-spectral layout: the symbol is precomputed on the PADDED spectral grid and
-device_put with the plan's output sharding, so applying it is one fused
-elementwise multiply per shard, with no re-distribution beyond the plan's
-own transposes.
+The solver drives the plan through the solver protocol of
+``models/base.py`` (``exec_fwd``/``exec_inv``, ``transform_axes``,
+``spectral_halved_axis``), so it runs unchanged on every plan family:
+slab (any sequence), pencil, and the batched-2D plan — there the batch
+axis is a pure broadcast dimension and each plane is an independent 2D
+Poisson solve. The whole solve (symbol multiply included) runs in the
+plan's distributed spectral layout: the symbol is broadcast from 1D
+wavenumber vectors on the PADDED spectral grid inside the jitted apply
+(with the plan's output sharding), so applying it is one fused
+elementwise multiply per shard, with no re-distribution beyond the
+plan's own transposes.
 
 Two wavenumber conventions:
 
@@ -20,8 +26,26 @@ Two wavenumber conventions:
 * ``mode="integer"``: the reference's convention (integer wavenumbers,
   Nyquist zeroed) for bit-compatible comparisons with testcase 4.
 
-The k = 0 mode is set to zero (zero-mean gauge, the standard periodic
-compatibility condition).
+Boundary conditions (``bc``, the R2R upgrade — see ``solvers/r2r.py``
+for the underlying extension identities):
+
+* ``"periodic"`` (default): the classic periodic box; the k = 0 mode is
+  set to zero (zero-mean gauge, the standard compatibility condition).
+* ``"dirichlet"`` — homogeneous u = 0 walls on the staggered grid
+  x_j = (j + 1/2) L / n: the input is ODD-extended along the axis
+  (period 2L, the DST-II extension) before the plan's transform, and the
+  folded wavenumbers become k_m = π m / L. The extension makes the FFT
+  spectrum live entirely in the sine basis, so the diagonal symbol
+  divide IS the DST-space solve — no twiddle extraction needed.
+* ``"neumann"`` — homogeneous ∂u/∂n = 0 walls, the EVEN (DCT-II)
+  extension, same folded k_m = π m / L.
+
+Per-axis mixing is supported (``bc=("dirichlet", "periodic",
+"neumann")``); non-periodic axes require the PLAN to be built at the
+EXTENDED extent (2n for an interior of n — ``interior_shape`` reports
+the solve domain) and ``solve`` then takes/returns interior-shaped
+arrays. A plan whose non-periodic axis is odd cannot host the extension
+and is rejected at construction.
 """
 
 from __future__ import annotations
@@ -34,12 +58,13 @@ import jax
 import jax.numpy as jnp
 
 from .. import params as pm
-from ..models.slab import SlabFFTPlan
+
+_BCS = ("periodic", "dirichlet", "neumann")
 
 
 def _axis_freqs(n: int, ext: int, halved: bool, integer_mode: bool) -> np.ndarray:
-    """Folded wavenumber per spectral index along one axis, zero in pad
-    lanes (ext >= logical spectral extent).
+    """Folded wavenumber per spectral index along one PERIODIC axis, zero
+    in pad lanes (ext >= logical spectral extent).
 
     integer mode replicates the reference kernel's fold exactly
     (``random_dist_default.cu:80-88``): k = i for i < n//2, k = n - i for
@@ -65,60 +90,140 @@ def _axis_freqs(n: int, ext: int, halved: bool, integer_mode: bool) -> np.ndarra
     return k
 
 
+def _extension_freqs(n_ext: int, ext: int, halved: bool) -> np.ndarray:
+    """Folded HALF-integer-grid wavenumber index for a DCT/DST-extended
+    axis: the plan transforms the period-2L extension of length
+    ``n_ext = 2n``, whose FFT bin m carries the cosine/sine mode
+    ``fold(m) = min(m, n_ext - m)`` at k = π·fold(m)/L. (The symbol must
+    be symmetric under m <-> n_ext - m to preserve the extension's
+    symmetry class — a fold, not a signed fftfreq.) Zero in pad lanes."""
+    k = np.zeros(ext)
+    cnt = n_ext // 2 + 1 if halved else n_ext
+    m = np.arange(cnt, dtype=np.float64)
+    k[:cnt] = np.minimum(m, n_ext - m)
+    return k
+
+
+def _parse_bc(bc, axes: Tuple[int, ...], ndim: int = 3):
+    """Per-array-axis bc tuple from a scalar or per-axis sequence; axes
+    outside ``axes`` (the batch axis of a batched-2D plan) must stay
+    periodic (they are not transformed at all)."""
+    if isinstance(bc, str):
+        per = ["periodic"] * ndim
+        for a in axes:
+            per[a] = bc
+    else:
+        per = [str(b) for b in bc]
+        if len(per) != ndim:
+            raise ValueError(f"bc must be a string or a length-{ndim} "
+                             f"sequence, got {bc!r}")
+    for a, b in enumerate(per):
+        if b not in _BCS:
+            raise ValueError(f"unknown bc {b!r} (choose from {_BCS})")
+        if b != "periodic" and a not in axes:
+            raise ValueError(f"axis {a} is not transformed by this plan "
+                             f"(transform_axes={axes}); only 'periodic' "
+                             "is meaningful there")
+    return tuple(per)
+
+
 class PoissonSolver:
-    """Periodic Poisson solve on top of a distributed FFT plan."""
+    """Poisson solve on top of any distributed FFT plan family."""
 
     def __init__(self, plan, lengths: Optional[Sequence[float]] = None,
-                 mode: str = "physical"):
+                 mode: str = "physical", bc="periodic"):
         if mode not in ("physical", "integer"):
             raise ValueError(f"mode must be 'physical' or 'integer', got {mode!r}")
         self.plan = plan
         self.mode = mode
-        g = plan.global_size
+        axes = tuple(plan.transform_axes)
+        dims = tuple(int(n) for n in plan.input_shape)
+        self.bc = _parse_bc(bc, axes, len(dims))
+        if mode == "integer" and any(b != "periodic" for b in self.bc):
+            raise ValueError("mode='integer' is the reference's periodic "
+                             "testcase convention; non-periodic boxes use "
+                             "mode='physical'")
+        for a, b in enumerate(self.bc):
+            if b != "periodic" and dims[a] % 2:
+                raise ValueError(
+                    f"axis {a} has bc={b!r}: the plan must be built at the "
+                    f"even EXTENDED extent 2n (got {dims[a]}) — the solver "
+                    "odd/even-extends an interior of n samples")
         if lengths is None:
-            lengths = (2 * np.pi,) * 3
+            lengths = (2 * np.pi,) * len(dims)
         self.lengths = tuple(float(v) for v in lengths)
 
         shape = plan.output_padded_shape
         halved_axis = self._halved_axis()
-        dims = [g.nx, g.ny, g.nz]
         rt, _ = _plan_dtypes(plan)
         ks = []
-        for ax in range(3):
-            k = _axis_freqs(dims[ax], shape[ax], ax == halved_axis,
-                            mode == "integer")
-            if mode == "physical":
-                k = k * (2 * np.pi / self.lengths[ax])
+        for ax in range(len(dims)):
+            if ax not in axes:
+                # Pure batch axis (batched-2D plans): the symbol is
+                # constant along it — each plane solves independently.
+                k = np.zeros(shape[ax])
+            elif self.bc[ax] == "periodic":
+                k = _axis_freqs(dims[ax], shape[ax], ax == halved_axis,
+                                mode == "integer")
+                if mode == "physical":
+                    k = k * (2 * np.pi / self.lengths[ax])
+            else:
+                # Extended axis: plan length 2n over period 2L ->
+                # k = (2π/2L)·fold(m) = π·fold(m)/L with L the INTERIOR
+                # domain length.
+                k = _extension_freqs(dims[ax], shape[ax],
+                                     ax == halved_axis)
+                k = k * (np.pi / self.lengths[ax])
             ks.append(k.astype(rt))
-        # Only the three 1D wavenumber vectors are stored; the dense symbol
-        # is formed by broadcasting inside the jitted apply, so each device
+        # Only the 1D wavenumber vectors are stored; the dense symbol is
+        # formed by broadcasting inside the jitted apply, so each device
         # materializes (at most) its own shard — at the module's 2048^3
         # target a host-side dense cube would be tens of GB.
         self._ks = ks
-        # Fold the round-trip normalization into the symbol so the solve is
-        # exactly: inverse(forward(f) * symbol).
-        self._scale = (1.0 / g.n_total
+        # Fold the round-trip normalization into the symbol so the solve
+        # is exactly: inverse(forward(f) * symbol). The transform volume
+        # is ``plan.transform_size`` — the TRANSFORMED axes only (a
+        # batched-2D plan's batch axis carries no 1/N).
+        self._scale = (1.0 / float(plan.transform_size)
                        if plan.config.norm is pm.FFTNorm.NONE else 1.0)
         self._apply = None
         self._solve_pure = None
 
+    # -- shapes ------------------------------------------------------------
+
+    @property
+    def interior_shape(self) -> Tuple[int, ...]:
+        """The solve domain: the plan's logical shape with every
+        non-periodic axis halved (the plan transforms the 2n extension of
+        an n-sample interior). Equals ``plan.input_shape`` for the
+        all-periodic box."""
+        return tuple(n // 2 if b != "periodic" else n
+                     for n, b in zip(self.plan.input_shape, self.bc))
+
+    @property
+    def _extended(self) -> bool:
+        return any(b != "periodic" for b in self.bc)
+
     def _halved_axis(self) -> int:
-        plan = self.plan
-        if getattr(plan, "transform", "r2c") == "c2c":
-            return -1  # no halved axis
-        if isinstance(plan, SlabFFTPlan) and plan._seq.halved == "y":
-            return 1
-        return 2
+        h = self.plan.spectral_halved_axis
+        return -1 if h is None else h
+
+    # -- the spectral symbol ----------------------------------------------
 
     def _apply_pure(self):
         """The spectral symbol multiply as a pure function (shared by the
         jitted apply and ``solve_fn``)."""
-        k1, k2, k3 = (jnp.asarray(k) for k in self._ks)
+        ks = [jnp.asarray(k) for k in self._ks]
         scale = self._scale
+        nd = len(ks)
 
         def apply(c):
-            k2sum = (k1[:, None, None] ** 2 + k2[None, :, None] ** 2
-                     + k3[None, None, :] ** 2)
+            k2sum = None
+            for ax, k in enumerate(ks):
+                sl = [None] * nd
+                sl[ax] = slice(None)
+                term = k[tuple(sl)] ** 2
+                k2sum = term if k2sum is None else k2sum + term
             inv = jnp.where(k2sum > 0,
                             -scale / jnp.where(k2sum > 0, k2sum, 1.0), 0.0)
             return c * inv.astype(c.real.dtype)
@@ -133,37 +238,72 @@ class PoissonSolver:
             return jax.jit(apply, in_shardings=ns, out_shardings=ns)
         return jax.jit(apply)
 
+    # -- extension / restriction (the R2R boundary-condition machinery) ----
+
+    def _extend(self, f):
+        """Interior -> extension: odd ([x, -flip x], Dirichlet) or even
+        ([x, flip x], Neumann) per non-periodic axis. Pure jnp, so the
+        preamble differentiates (the vjp of concatenate+flip is
+        slice+flip)."""
+        for ax, b in enumerate(self.bc):
+            if b == "periodic":
+                continue
+            mirror = jnp.flip(f, axis=ax)
+            if b == "dirichlet":
+                mirror = -mirror
+            f = jnp.concatenate([f, mirror], axis=ax)
+        return f
+
+    def _restrict(self, u):
+        """Extension (padded) -> interior slab."""
+        sl = tuple(slice(0, n) for n in self.interior_shape)
+        return u[sl]
+
+    # -- execution ---------------------------------------------------------
+
     def solve_fn(self):
         """Pure solve pipeline (forward -> symbol multiply -> inverse) with
         no jit and no sharding annotations: composes under user transforms,
         so ``jax.grad`` flows through the full distributed spectral solve
         (see ``DistFFTPlan.forward_fn`` and tests/test_autodiff.py). Uses
-        the plan's transform family automatically (r2c or c2c)."""
+        the plan's transform family automatically (r2c or c2c). For a
+        non-periodic box the function maps interior -> interior (the
+        odd/even extension and the restriction are traced in)."""
         if self._solve_pure is None:
             plan = self.plan
             fwd, inv = plan.forward_fn(), plan.inverse_fn()
             apply = self._apply_pure()
+            if self._extended:
+                ext, restrict = self._extend, self._restrict
 
-            def fn(f):
-                return inv(apply(fwd(f)))
+                def fn(f):
+                    return restrict(inv(apply(fwd(ext(f)))))
+            else:
+                def fn(f):
+                    return inv(apply(fwd(f)))
 
             self._solve_pure = fn
         return self._solve_pure
 
     def solve(self, f):
-        """u with ∇²u = f (periodic, zero-mean). Accepts logical or padded
-        global shape; returns the plan's padded real-space array (crop with
-        ``plan.crop_real``)."""
+        """u with ∇²u = f (under this solver's ``bc``). Periodic box:
+        accepts the logical or padded global shape and returns the plan's
+        padded real-space array (crop with ``plan.crop_real``) — the
+        historical contract. Non-periodic box: takes the
+        ``interior_shape`` forcing and returns the interior solution."""
         plan = self.plan
         if self._apply is None:
             self._apply = self._build_apply()
-        if getattr(plan, "transform", "r2c") == "c2c":
-            c = plan.exec_c2c(f)
-            c = self._apply(c)
-            return plan.exec_c2c_inv(c)
-        c = plan.exec_r2c(f)
+        if self._extended:
+            if tuple(f.shape) != self.interior_shape:
+                raise ValueError(
+                    f"bc={self.bc}: solve expects the interior shape "
+                    f"{self.interior_shape}, got {tuple(f.shape)}")
+            f = self._extend(f)
+        c = plan.exec_fwd(f)
         c = self._apply(c)
-        return plan.exec_c2r(c)
+        u = plan.exec_inv(c)
+        return self._restrict(u) if self._extended else u
 
 
 def _plan_dtypes(plan) -> Tuple[np.dtype, np.dtype]:

@@ -1,0 +1,262 @@
+"""Real-to-real transforms (DCT / DST, types I-III) via the R2C machinery.
+
+Non-periodic boundary conditions live in the cosine/sine bases; this
+module exposes them scipy-compatibly (``scipy.fft.dct/dst`` conventions,
+``norm=None`` and ``"ortho"``) while routing every flop through the
+repo's local R2C layer (``ops/fft.py``), so a DCT inherits whichever
+backend the caller picked — XLA, the MXU matmul family, or Bluestein for
+extension lengths that fall off the smooth fast path.
+
+The construction is the classic even/odd EXTENSION + TWIDDLE
+post-processing:
+
+* DCT-II: y = [x, flip x] (the half-sample-symmetric extension, length
+  2n) -> ``rfft`` -> ``C[k] = Re(e^{-iπk/2n} Y[k])``;
+* DST-II: y = [x, -flip x] -> ``rfft`` -> ``S[k] = -Im(e^{-iπ(k+1)/2n}
+  Y[k+1])``;
+* DCT-I / DST-I: the whole-sample extensions (lengths 2(n-1) / 2(n+1)),
+  no twiddle (their spectra are already real / imaginary);
+* type III = the transpose of type II: reconstruct the extension
+  spectrum from the coefficients (the same twiddles, conjugated),
+  ``irfft``, and read the first n samples.
+
+The same identities power the Poisson solver's Dirichlet/Neumann boxes
+(``solvers/poisson.py bc=...``) — there the twiddle extraction is
+unnecessary because the solve is diagonal in the extended FFT basis;
+here it is exactly what converts FFT bins into the scipy-layout R2R
+coefficients.
+
+These are LOCAL (per-shard / host-array) transforms — axis-wise jnp
+functions that compose under jit/vmap/grad — not distributed plans: a
+distributed non-periodic solve goes through a plan built at the extended
+size (see ``PoissonSolver``). ``dctn``/``dstn`` apply along several axes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops import fft as lf
+from ..params import FFTNorm
+
+_NORMS = (None, "ortho")
+
+
+def _check(x, type: int, norm: Optional[str], kinds=(1, 2, 3)) -> None:
+    if type not in kinds:
+        raise ValueError(f"transform type must be one of {kinds}, got {type}")
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be None or 'ortho', got {norm!r}")
+    if jnp.iscomplexobj(x):
+        raise TypeError("R2R transforms take real input")
+
+
+def _dbl(x) -> bool:
+    return jnp.dtype(x.dtype) == jnp.dtype(np.float64)
+
+
+@functools.lru_cache(maxsize=None)
+def _twiddle_np(n: int, double: bool, shift: int = 0) -> np.ndarray:
+    """e^{-iπ(k+shift)/(2n)}, k in [0, n) — the half-sample phase that
+    aligns the length-2n extension spectrum with the DCT/DST layout."""
+    dt = np.complex128 if double else np.complex64
+    k = np.arange(n, dtype=np.float64) + shift
+    return np.exp(-1j * np.pi * k / (2 * n)).astype(dt)
+
+
+def _rfft(y, backend: str):
+    return lf.rfft(y, axis=-1, norm=FFTNorm.NONE, backend=backend)
+
+
+def _irfft(Y, n: int, backend: str):
+    return lf.irfft(Y, n=n, axis=-1, norm=FFTNorm.BACKWARD, backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# forward transforms along the LAST axis (norm=None scipy conventions)
+# ---------------------------------------------------------------------------
+
+
+def _dct2_last(x, backend: str):
+    n = x.shape[-1]
+    ext = jnp.concatenate([x, jnp.flip(x, axis=-1)], axis=-1)
+    Y = _rfft(ext, backend)[..., :n]
+    tw = jnp.asarray(_twiddle_np(n, _dbl(x)))
+    return jnp.real(tw * Y)
+
+
+def _dst2_last(x, backend: str):
+    n = x.shape[-1]
+    ext = jnp.concatenate([x, -jnp.flip(x, axis=-1)], axis=-1)
+    Y = _rfft(ext, backend)[..., 1: n + 1]
+    tw = jnp.asarray(_twiddle_np(n, _dbl(x), shift=1))
+    return -jnp.imag(tw * Y)
+
+
+def _dct1_last(x, backend: str):
+    n = x.shape[-1]
+    if n < 2:
+        raise ValueError("DCT-I needs n >= 2")
+    ext = jnp.concatenate([x, jnp.flip(x[..., 1:-1], axis=-1)], axis=-1)
+    return jnp.real(_rfft(ext, backend))[..., :n]
+
+
+def _dst1_last(x, backend: str):
+    n = x.shape[-1]
+    z = jnp.zeros(x.shape[:-1] + (1,), dtype=x.dtype)
+    ext = jnp.concatenate([z, x, z, -jnp.flip(x, axis=-1)], axis=-1)
+    return -jnp.imag(_rfft(ext, backend))[..., 1: n + 1]
+
+
+def _dct3_last(x, backend: str):
+    """Type III = 2n * (type-II inverse): rebuild the extension spectrum
+    Y[k] = conj(tw)[k] * x_k (Y[n] = 0 — the half-sample-symmetric class
+    has no Nyquist energy), irfft, read the first n samples."""
+    n = x.shape[-1]
+    dbl = _dbl(x)
+    cdt = np.complex128 if dbl else np.complex64
+    tw = jnp.asarray(np.conj(_twiddle_np(n, dbl)))
+    Y = x.astype(cdt) * tw
+    Y = jnp.concatenate([Y, jnp.zeros(Y.shape[:-1] + (1,), dtype=Y.dtype)],
+                        axis=-1)
+    ext = _irfft(Y, 2 * n, backend)
+    return 2 * n * ext[..., :n]
+
+
+def _dst3_last(x, backend: str):
+    """Type III = 2n * (type-II inverse): Y[m] = -i conj(tw)[m] x_{m-1}
+    for m in [1, n], Y[0] = 0 (an odd extension has zero mean)."""
+    n = x.shape[-1]
+    dbl = _dbl(x)
+    cdt = np.complex128 if dbl else np.complex64
+    tw = jnp.asarray(np.conj(_twiddle_np(n, dbl, shift=1)))
+    Y = -1j * tw * x.astype(cdt)
+    Y = jnp.concatenate([jnp.zeros(Y.shape[:-1] + (1,), dtype=Y.dtype), Y],
+                        axis=-1)
+    ext = _irfft(Y, 2 * n, backend)
+    return 2 * n * ext[..., :n]
+
+
+# ---------------------------------------------------------------------------
+# ortho scalings (scipy conventions; orthonormal matrices, so type III
+# ortho is exactly the inverse of type II ortho)
+# ---------------------------------------------------------------------------
+
+
+def _ortho_post_2(y, kind: str):
+    """Post-scale a norm=None type-II result to ortho: sqrt(1/(2n))
+    everywhere except the distinguished element (k=0 for DCT, k=n-1 for
+    DST) at sqrt(1/(4n))."""
+    n = y.shape[-1]
+    f = np.full(n, math.sqrt(1.0 / (2 * n)))
+    f[0 if kind == "dct" else n - 1] = math.sqrt(1.0 / (4 * n))
+    return y * jnp.asarray(f.astype("float64" if _dbl(y) else "float32"))
+
+
+def _ortho_pre_3(x, kind: str):
+    """Pre-scale type-III ortho input: the transpose of ``_ortho_post_2``
+    composed with the g-diagonal relating type III to the type-II
+    transpose (distinguished element carries 2*sqrt(1/(4n)) =
+    sqrt(1/n))."""
+    n = x.shape[-1]
+    f = np.full(n, math.sqrt(1.0 / (2 * n)))
+    f[0 if kind == "dct" else n - 1] = math.sqrt(1.0 / n)
+    return x * jnp.asarray(f.astype("float64" if _dbl(x) else "float32"))
+
+
+# ---------------------------------------------------------------------------
+# public API (scipy.fft signatures, + backend)
+# ---------------------------------------------------------------------------
+
+
+def dct(x, type: int = 2, axis: int = -1, norm: Optional[str] = None,
+        backend: str = "xla"):
+    """Discrete cosine transform (types 1-3, scipy conventions). ``norm``
+    is None (unnormalized) or "ortho"; ``backend`` picks the local R2C
+    implementation (``ops/fft.py``)."""
+    _check(x, type, norm)
+    if type == 1 and norm == "ortho":
+        raise NotImplementedError("ortho-normalized DCT-I is not provided "
+                                  "(types 2/3 cover the solver suite)")
+    y = jnp.moveaxis(jnp.asarray(x), axis, -1)
+    if type == 1:
+        out = _dct1_last(y, backend)
+    elif type == 2:
+        out = _dct2_last(y, backend)
+        if norm == "ortho":
+            out = _ortho_post_2(out, "dct")
+    else:
+        out = _dct3_last(_ortho_pre_3(y, "dct"), backend) if norm == "ortho" \
+            else _dct3_last(y, backend)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def dst(x, type: int = 2, axis: int = -1, norm: Optional[str] = None,
+        backend: str = "xla"):
+    """Discrete sine transform (types 1-3, scipy conventions)."""
+    _check(x, type, norm)
+    if type == 1 and norm == "ortho":
+        raise NotImplementedError("ortho-normalized DST-I is not provided")
+    y = jnp.moveaxis(jnp.asarray(x), axis, -1)
+    if type == 1:
+        out = _dst1_last(y, backend)
+    elif type == 2:
+        out = _dst2_last(y, backend)
+        if norm == "ortho":
+            out = _ortho_post_2(out, "dst")
+    else:
+        out = _dst3_last(_ortho_pre_3(y, "dst"), backend) if norm == "ortho" \
+            else _dst3_last(y, backend)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def idct(x, type: int = 2, axis: int = -1, norm: Optional[str] = None,
+         backend: str = "xla"):
+    """Inverse DCT (scipy ``idct``): the ortho family is self-inverse via
+    the transpose; norm=None divides by the roundtrip factor (2n for
+    types 2/3, 2(n-1) for type 1)."""
+    _check(x, type, norm)
+    n = jnp.asarray(x).shape[axis]
+    inv_type = {1: 1, 2: 3, 3: 2}[type]
+    y = dct(x, type=inv_type, axis=axis, norm=norm, backend=backend)
+    if norm is None:
+        y = y / (2.0 * (n - 1) if type == 1 else 2.0 * n)
+    return y
+
+
+def idst(x, type: int = 2, axis: int = -1, norm: Optional[str] = None,
+         backend: str = "xla"):
+    """Inverse DST (scipy ``idst``)."""
+    _check(x, type, norm)
+    n = jnp.asarray(x).shape[axis]
+    inv_type = {1: 1, 2: 3, 3: 2}[type]
+    y = dst(x, type=inv_type, axis=axis, norm=norm, backend=backend)
+    if norm is None:
+        y = y / (2.0 * (n + 1) if type == 1 else 2.0 * n)
+    return y
+
+
+def dctn(x, type: int = 2, axes: Optional[Sequence[int]] = None,
+         norm: Optional[str] = None, backend: str = "xla"):
+    """Separable multi-axis DCT (scipy ``dctn``)."""
+    if axes is None:
+        axes = range(jnp.asarray(x).ndim)
+    for a in axes:
+        x = dct(x, type=type, axis=a, norm=norm, backend=backend)
+    return x
+
+
+def dstn(x, type: int = 2, axes: Optional[Sequence[int]] = None,
+         norm: Optional[str] = None, backend: str = "xla"):
+    """Separable multi-axis DST (scipy ``dstn``)."""
+    if axes is None:
+        axes = range(jnp.asarray(x).ndim)
+    for a in axes:
+        x = dst(x, type=type, axis=a, norm=norm, backend=backend)
+    return x

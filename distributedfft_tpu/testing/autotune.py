@@ -175,7 +175,15 @@ def autotune_local_fft(shape: Sequence[int], budget_rel_err: float = 1e-4,
 
     cands: List[Candidate] = []
     n_max = int(max(shape))
+    from ..ops.bluestein import is_smooth
     for b in backends:
+        if b == "bluestein" and all(is_smooth(int(n)) for n in shape):
+            # On a 5-smooth shape the bluestein backend delegates every
+            # axis to the XLA expansion and is bit-identical to "xla" —
+            # racing it would time the same program twice. It joins the
+            # race exactly when some axis would otherwise fall off the
+            # fast path (prime / non-smooth lengths).
+            continue
         if b in ("matmul", "matmul-r2") and not double_prec:
             cands += [Candidate(b, "high"), Candidate(b, "highest")]
             # Past the deployed direct threshold the default plan is the

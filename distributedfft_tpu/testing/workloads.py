@@ -79,6 +79,45 @@ def batched2d_chain(k: int, batch: int, nx: int, ny: int,
     return jax.jit(fn), plan
 
 
+def ns2d_chain(k: int, batch: int, n: int, dt: float = 1e-3,
+               viscosity: float = 1e-3, backend: str = "matmul",
+               partition: pm.SlabPartition | None = None, mesh=None,
+               shard: str = "batch"):
+    """Jitted scalar-fenced chain of ``k`` RK4 Navier-Stokes-2D steps on
+    a ``(batch, n, n)`` vorticity ensemble (solvers/navier_stokes.py) —
+    the solvers bench's step-time workload. Each step is 20 distributed
+    forward/inverse transforms (4 RHS evaluations x 5), the serving
+    layer's steady-state traffic shape in miniature.
+
+    Returns ``(fn, solver)`` with ``fn(w0) -> scalar`` (sum of |ω| after
+    k steps; the scalar readback is the fence, chaintimer convention)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.batched2d import Batched2DFFTPlan
+    from ..solvers.navier_stokes import NavierStokes2D
+
+    plan = Batched2DFFTPlan(batch, n, n, partition or pm.SlabPartition(1),
+                            pm.Config(fft_backend=backend), mesh=mesh,
+                            shard=shard)
+    solver = NavierStokes2D(plan, viscosity)
+    sfn = solver.solve_fn(k, dt)
+
+    def fn(w0):
+        return jnp.sum(jnp.abs(sfn(w0)))
+
+    return jax.jit(fn), solver
+
+
+def flops_ns2d_step(batch: int, n: int) -> float:
+    """Nominal FFT flops of ONE RK4 NS-2D step: 4 RHS evaluations x 5
+    transforms (4 inverse + 1 forward), each a 2D transform of the
+    stack (the elementwise work is O(N) and omitted, the
+    flops_roundtrip_3d convention)."""
+    import math
+    return 4 * 5 * 2.5 * batch * n * n * math.log2(float(n) * n)
+
+
 def flops_roundtrip_3d(n: int) -> float:
     """R2C + C2R flops for an ``n^3`` volume: 2.5·N^3·log2(N^3) per
     direction (BASELINE.md §Derived). The single shared FLOP model —

@@ -48,7 +48,16 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from . import hloscan
 
 # Rendering keys of a single exchange (``ExchangeDecl.rendering``).
-RENDERINGS = ("a2a", "streams", "ring", "p2p")
+# "ring_overlap" is the double-buffered ring schedule (SendMethod.
+# RING_OVERLAP, with or without the fused wire kernels): same census
+# algebra and (P-1)/P payload discount as "ring" — the permutes must stay
+# distinct and un-fusable whichever schedule issued them, which is
+# exactly the pin that stops GSPMD from serializing the overlap back.
+RENDERINGS = ("a2a", "streams", "ring", "ring_overlap", "p2p")
+
+# The renderings that stage a ppermute ring (shared by the census and
+# payload resolution below).
+_RING_RENDERINGS = ("ring", "ring_overlap")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,6 +171,8 @@ def rendering_name(config: Any, second: bool = False) -> str:
 
     comm = config.resolved_comm2() if second else config.comm_method
     send = config.resolved_snd2() if second else config.send_method
+    if send is pm.SendMethod.RING_OVERLAP:
+        return "ring_overlap"
     if send is pm.SendMethod.RING:
         return "ring"
     if send is pm.SendMethod.STREAMS:
@@ -202,14 +213,15 @@ def contract_for(plan: Any, direction: str = "forward",
             n_a2a += 1
         elif d.rendering == "streams":
             n_a2a += max(1, d.chunks)
-        elif d.rendering == "ring":
+        elif d.rendering in _RING_RENDERINGS:
             ring_steps += max(0, d.axis_size - 1)
         else:
             n_gspmd += 1
         if d.rendering != "p2p":
             payload += hloscan.predicted_payload_bytes(
                 d.payload_shape, cdt, wire,
-                ring_size=d.axis_size if d.rendering == "ring" else 0)
+                ring_size=(d.axis_size
+                           if d.rendering in _RING_RENDERINGS else 0))
 
     rules: List[Rule] = []
     summary = "+".join(sorted({d.rendering for d in decls})) or "none"

@@ -46,8 +46,13 @@ def build_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--families", default="slab,pencil,batched",
                     help="comma list of plan families to verify")
-    ap.add_argument("--renderings", default="a2a,opt1,p2p,streams,ring",
-                    help="comma list of exchange renderings")
+    ap.add_argument("--renderings",
+                    default="a2a,opt1,p2p,streams,ring,ring_ovl,fused",
+                    help="comma list of exchange renderings (ring_ovl = "
+                         "SendMethod.RING_OVERLAP, the double-buffered "
+                         "ring; fused = RING_OVERLAP + Config.fused_wire, "
+                         "the fused Pallas wire kernels — active on the "
+                         "bf16 wire cells, inert on native)")
     ap.add_argument("--wires", default="native,bf16",
                     help="comma list of wire dtypes")
     ap.add_argument("--guards", default="off,check",
@@ -103,6 +108,10 @@ def _config(rendering: str, wire: str, guards: str) -> Any:
                   send_method=pm.SendMethod.STREAMS, streams_chunks=3)
     elif rendering == "ring":
         kw.update(send_method=pm.SendMethod.RING)
+    elif rendering == "ring_ovl":
+        kw.update(send_method=pm.SendMethod.RING_OVERLAP)
+    elif rendering == "fused":
+        kw.update(send_method=pm.SendMethod.RING_OVERLAP, fused_wire=True)
     else:
         raise ValueError(f"unknown rendering {rendering!r}")
     return dfft.Config(wire_dtype=wire, guards=guards, use_wisdom=False,

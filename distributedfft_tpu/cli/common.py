@@ -113,6 +113,10 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                              "Ring (ppermute-ring exchange with per-block "
                              "FFTs pipelined between steps; owns the "
                              "rendering regardless of comm method) | "
+                             "RingOverlap (the ring on the double-"
+                             "buffered schedule — bit-identical output, "
+                             "one transfer in flight under every "
+                             "block's compute) | "
                              "MPI_Type (alias of Sync)")
         ap.add_argument("--comm-method2", "-comm2", default=None,
                         help="same as --comm-method1 for transpose 2")
@@ -128,6 +132,10 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                              "Ring (ppermute-ring exchange with per-block "
                              "FFTs pipelined between steps; owns the "
                              "rendering regardless of comm method) | "
+                             "RingOverlap (the ring on the double-"
+                             "buffered schedule — bit-identical output, "
+                             "one transfer in flight under every "
+                             "block's compute) | "
                              "MPI_Type (alias of Sync)")
     ap.add_argument("--streams-chunks", type=int, default=None,
                     help="piece count for the Streams pipelined transpose "

@@ -50,8 +50,10 @@ _PENCIL_FILE_RE = re.compile(
     r"_(?P<p1>\d+)_(?P<p2>\d+)(?:_w(?P<wire>\d+))?\.csv$")
 
 _COMM_NAMES = {0: "Peer2Peer", 1: "All2All"}
-# 3 = the RING extension, 0-2 the reference's own codes (params.hpp:87-89).
-_SND_NAMES = {0: "Sync", 1: "Streams", 2: "MPI_Type", 3: "Ring"}
+# 3/4 = the RING / RING_OVERLAP extensions, 0-2 the reference's own codes
+# (params.hpp:87-89).
+_SND_NAMES = {0: "Sync", 1: "Streams", 2: "MPI_Type", 3: "Ring",
+              4: "RingOverlap"}
 _WIRE_NAMES = {1: "bf16"}
 
 _VARIANT_LABELS = {

@@ -37,7 +37,7 @@ import re
 from typing import Optional, Tuple
 
 from ..ops.bluestein import chirp_length, is_smooth
-from ..ops.mxu_fft import DIRECT_MAX, _R2_BASE, _split
+from ..ops.mxu_fft import DIRECT_MAX, _R2_BASE, _split_for
 
 V5E_PEAK_BF16_TFLOPS = 197.0
 
@@ -74,7 +74,10 @@ def macs_c2c_axis(n: int, direct_max: int = DIRECT_MAX, *,
                              complex_mults=complex_mults)
     if n <= direct_max:
         return float(complex_mults) * n
-    n1, n2 = _split(n)
+    # The four-step factor choice mirrors _fft_last's _split_for
+    # dispatch: the MXU-deep split (dominant factor = largest divisor
+    # <= direct_max) when both factors stay direct, balanced otherwise.
+    n1, n2 = _split_for(n, direct_max)
     if n1 == 1:
         return float(complex_mults) * n
     return (macs_c2c_axis(n2, direct_max, radix2=radix2,
@@ -92,7 +95,7 @@ def macs_r2c_axis(n: int, direct_max: int = DIRECT_MAX, *,
     n_out = n // 2 + 1
     if n <= direct_max:
         return 2.0 * n_out
-    n1, n2 = _split(n)
+    n1, n2 = _split_for(n, direct_max)
     if n1 == 1:
         return 2.0 * n_out
     return 2.0 * n2 + macs_c2c_axis(n1, direct_max,
@@ -195,6 +198,165 @@ def mxu_flops_batched2d(batch: int, m: int, direct_max: int = DIRECT_MAX,
                   + m * m * macs_c2r_axis(m, direct_max, radix2=radix2,
                                           complex_mults=complex_mults))
     return 2.0 * batch * macs_plane
+
+
+# ---------------------------------------------------------------------------
+# roofline_fraction: the tracked per-row gate (ISSUE 10 / ROADMAP item 3)
+# ---------------------------------------------------------------------------
+#
+# ``roofline_fraction = ideal_ms / measured_ms``: the fraction of the
+# model's 100%-of-effective-peak time a measured row achieved. The model
+# is the SAME per-plan expectation dfft-explain prints — the exact MXU MAC
+# count for the matmul-family backends, the nominal 2.5·N·log2 N flops for
+# everything else — against the v5e effective peak, divided by the mesh
+# size for distributed rows (per-chip share of the transform work; the
+# exchange is deliberately NOT in the denominator, so communication time
+# shows up as lost fraction — that is the seam this gate exists to track).
+# On a non-TPU backend (the CPU test mesh) the v5e peak makes the fraction
+# a tiny TRACKING number, not a utilization claim: it is comparable across
+# runs of the same host, which is all the CI regression gate needs.
+
+
+def _parse_size(shape):
+    """Normalize a workload size to ``("cube", n)`` / ``("b2d", (b, m))``
+    or None: accepts an int (cube edge), a ``"256^3"`` / ``"4096^2x64"``
+    string (the bench row-key forms; a trailing ``:inverse``-style mode
+    tag is ignored), or a shape tuple — (n, n, n) cubes and (b, m, m)
+    batched planes."""
+    if isinstance(shape, str):
+        s = shape.split(":")[0]
+        m = re.fullmatch(r"(\d+)(\^3)?", s)
+        if m:
+            return "cube", int(m.group(1))
+        m = re.fullmatch(r"(\d+)\^2x(\d+)", s)
+        if m:
+            return "b2d", (int(m.group(2)), int(m.group(1)))
+        return None
+    if isinstance(shape, int):
+        return "cube", int(shape)
+    t = tuple(int(v) for v in shape)
+    if len(t) == 3 and t[0] == t[1] == t[2]:
+        return "cube", t[0]
+    if len(t) == 3 and t[1] == t[2]:
+        return "b2d", (t[0], t[1])
+    return None
+
+
+def _backend_model(backend: str):
+    """(counts_on_mxu, precision, radix2) for a bench/Config backend
+    label — bare names ("matmul") and CSV forms ("matmul@high") both
+    resolve; non-matmul backends fall to the nominal model."""
+    base = str(backend).split()[0]
+    name, _, prec = base.partition("@")
+    if name in ("matmul", "matmul-planes"):
+        return True, (prec or "high"), False
+    if name == "matmul-r2":
+        return True, (prec or "high"), True
+    return False, "high", False
+
+
+def ideal_time_ms(shape, backend: str, *, devices: int = 1,
+                  mode: str = "roundtrip",
+                  direct_max: "Optional[int]" = None) -> Optional[float]:
+    """The per-plan expectation: the time ``mode`` of this workload would
+    take at 100% of the v5e effective MXU peak — exact MACs (4mm bound)
+    for the matmul family, nominal FFT flops for other backends. None
+    when the shape is outside the model (non-cube/non-square-batched).
+    ``devices`` divides the work (per-chip share); ``direct_max``
+    overrides the plan threshold (the ``direct(N)`` bench plan note)."""
+    parsed = _parse_size(shape)
+    if parsed is None or devices < 1:
+        return None
+    kind, dims = parsed
+    mxu, precision, r2 = _backend_model(backend)
+    dmax = DIRECT_MAX if direct_max is None else int(direct_max)
+    if kind == "cube":
+        n = dims
+        if mxu:
+            flops = mxu_flops_roundtrip_3d(n, dmax, radix2=r2)
+        else:
+            from ..testing.workloads import flops_roundtrip_3d
+            flops = flops_roundtrip_3d(n)
+    else:
+        b, m = dims
+        if mxu:
+            flops = mxu_flops_batched2d(b, m, dmax, radix2=r2)
+        else:
+            from ..testing.workloads import flops_batched2d
+            flops = flops_batched2d(b, m, m)
+    if mode != "roundtrip":  # forward / inverse / forward-chunked
+        flops /= 2.0
+    peak = effective_peak_tflops(precision)
+    return flops / (peak * 1e12) / float(devices) * 1e3
+
+
+def _mesh_devices(mesh) -> int:
+    """Device count of a mesh-ish argument: None (single chip), an int,
+    or a ``jax.sharding.Mesh``."""
+    if mesh is None:
+        return 1
+    if isinstance(mesh, int):
+        return max(1, mesh)
+    devs = getattr(mesh, "devices", None)
+    return int(devs.size) if devs is not None else 1
+
+
+def roofline_row(measured_ms: float, shape, backend: str, mesh=None, *,
+                 mode: str = "roundtrip",
+                 direct_max: "Optional[int]" = None) -> Optional[dict]:
+    """The tracked roofline record for one measured row (what bench.py
+    writes under BENCH_DETAILS.json's ``"roofline"`` block): the model's
+    ideal time, the achieved ``roofline_fraction``, and which model
+    produced it. None when unmodelable (bad shape / degenerate time)."""
+    if not measured_ms or measured_ms <= 0:
+        return None
+    devices = _mesh_devices(mesh)
+    ideal = ideal_time_ms(shape, backend, devices=devices, mode=mode,
+                          direct_max=direct_max)
+    if ideal is None:
+        return None
+    mxu, precision, _ = _backend_model(backend)
+    # Significant digits, not fixed decimals: CPU tracking rows sit many
+    # orders below the v5e model and must never round to a 0.0 the gate
+    # would reject.
+    return {
+        "ideal_ms": float(f"{ideal:.4g}"),
+        "roofline_fraction": float(f"{ideal / measured_ms:.4g}"),
+        "model": (f"mxu-4mm@{precision}" if mxu else "nominal@high"),
+        "mode": mode,
+        "devices": devices,
+    }
+
+
+def roofline_fraction(measured_ms: float, shape, backend: str,
+                      mesh=None, *, mode: str = "roundtrip",
+                      direct_max: "Optional[int]" = None
+                      ) -> Optional[float]:
+    """``ideal_time_ms / measured_ms`` — the honest, tracked fraction of
+    the per-plan roofline a measurement achieved (ROADMAP item 3's gate:
+    every perf PR must move this number, and the CI roofline job fails a
+    >10% regression against the committed BENCH_DETAILS.json)."""
+    row = roofline_row(measured_ms, shape, backend, mesh, mode=mode,
+                       direct_max=direct_max)
+    return None if row is None else row["roofline_fraction"]
+
+
+_BENCH_DETAILS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "..", "..", "BENCH_DETAILS.json")
+
+
+def tracked_fractions(path: Optional[str] = None) -> dict:
+    """The committed ``"roofline"`` rows of BENCH_DETAILS.json (row key ->
+    record), or {} when the artifact/block is absent — what dfft-explain
+    quotes as the tracked fraction and the CI job regresses against."""
+    import json
+    try:
+        with open(path or _BENCH_DETAILS, encoding="utf-8") as f:
+            data = json.load(f)
+        rows = data.get("roofline", {}).get("rows", {})
+        return rows if isinstance(rows, dict) else {}
+    except (OSError, ValueError):
+        return {}
 
 
 # ---------------------------------------------------------------------------

@@ -435,11 +435,13 @@ class Batched2DFFTPlan:
         independent exchange->FFT piece chains, exactly like the slab
         engine's pipelined rendering.
 
-        ``SendMethod.RING`` renders the exchange as the ``P-1``-step
-        ``lax.ppermute`` ring (``ring_transpose``) — owning the rendering
-        regardless of ``comm_method``, the slab contract. The
+        ``SendMethod.RING`` / ``RING_OVERLAP`` render the exchange as the
+        ``P-1``-step ``lax.ppermute`` ring (``ring_transpose``;
+        RING_OVERLAP on the double-buffered schedule) — owning the
+        rendering regardless of ``comm_method``, the slab contract. The
         post-transpose FFT runs along the gathered axis, so no per-block
-        compute is pipelined; ``last`` runs on the assembled block."""
+        compute is pipelined; ``last`` runs on the assembled block (the
+        fused wire therefore uses the unpack-only arrival kernel)."""
         first, xpose, last = self._slab_parts(forward)
         mesh = self.mesh
         if forward:
@@ -447,12 +449,18 @@ class Batched2DFFTPlan:
         else:
             in_spec, out_spec = self._out_spec, self._in_spec
         wire = self.config.wire_dtype
-        if self.config.send_method is pm.SendMethod.RING:
+        if self.config.send_method.is_ring:
             split, concat = (2, 1) if forward else (1, 2)
+            overlap = self.config.send_method is pm.SendMethod.RING_OVERLAP
+            from ..ops import pallas_fft as plf
+            enc_fn, arr_fn = plf.fused_ring_hooks(self.config)
 
             def rbody(v):
                 return last(ring_transpose(first(v), SLAB_AXIS, split,
-                                           concat, wire=wire))
+                                           concat, wire=wire,
+                                           overlap=overlap,
+                                           encode_fn=enc_fn,
+                                           arrive_fn=arr_fn))
 
             return (jax.shard_map(rbody, mesh=mesh, in_specs=in_spec,
                                   out_specs=out_spec),

@@ -633,25 +633,31 @@ class PencilFFTPlan(DistFFTPlan):
         re-fuses the pieces into one collective (see
         ``SlabFFTPlan._assemble_pure``), so this is equivalent to SYNC;
         ALL2ALL is the genuinely chunked rendering.
-        RING (any comm): the transpose rendered as the ``P-1``-step
-        ``lax.ppermute`` ring (``ring_transpose`` over ``xinfo =
-        (axis_name, split, concat)``), fused into the previous segment — a
-        ring is only expressible inside shard_map, so RING owns the
-        rendering regardless of ``comm``. Every pencil post-transpose FFT
-        runs along the gathered axis (the received blocks are disjoint
-        slices of exactly that axis), so no per-block compute is pipelined
-        here; the win is the ``P-1`` distinct, independently schedulable
-        collective-permutes GSPMD cannot re-fuse the way it re-fuses the
-        chunked reshards.
+        RING / RING_OVERLAP (any comm): the transpose rendered as the
+        ``P-1``-step ``lax.ppermute`` ring (``ring_transpose`` over
+        ``xinfo = (axis_name, split, concat)``; RING_OVERLAP issues each
+        step's permute on the double-buffered schedule), fused into the
+        previous segment — a ring is only expressible inside shard_map,
+        so the ring renderings own the exchange regardless of ``comm``.
+        Every pencil post-transpose FFT runs along the gathered axis (the
+        received blocks are disjoint slices of exactly that axis), so no
+        per-block compute is pipelined here; the win is the ``P-1``
+        distinct, independently schedulable collective-permutes GSPMD
+        cannot re-fuse the way it re-fuses the chunked reshards (and the
+        fused wire uses the unpack-only arrival kernel).
         """
-        if snd is pm.SendMethod.RING:
+        if snd.is_ring:
             prev_fn, _ = segments[-1]
             axis_name, split, concat = xinfo
             wire = self.config.wire_dtype
+            overlap = snd is pm.SendMethod.RING_OVERLAP
+            from ..ops import pallas_fft as plf
+            enc_fn, arr_fn = plf.fused_ring_hooks(self.config, snd)
 
             def rseg(c, f=prev_fn):
                 return ring_transpose(f(c), axis_name, split, concat,
-                                      wire=wire)
+                                      wire=wire, overlap=overlap,
+                                      encode_fn=enc_fn, arrive_fn=arr_fn)
 
             segments[-1] = (rseg, spec_after)
             return False

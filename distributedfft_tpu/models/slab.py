@@ -489,7 +489,7 @@ class SlabFFTPlan(DistFFTPlan):
 
         return body
 
-    # -- RING (ppermute-pipelined) bodies ----------------------------------
+    # -- RING / RING_OVERLAP (ppermute-pipelined) bodies -------------------
     # SendMethod.RING decomposes each transpose into P-1 DISTINCT
     # ``lax.ppermute`` steps (``parallel/transpose.ring_transpose``) and
     # runs the post-transpose FFT stages that do not touch the gathered
@@ -502,6 +502,49 @@ class SlabFFTPlan(DistFFTPlan):
     # FFT (always axis 0 on the slab forward) needs the assembled block
     # and runs after the ring drains, as does the shape-changing C2R
     # half-axis inverse.
+    #
+    # SendMethod.RING_OVERLAP runs the SAME per-block math on the
+    # double-buffered schedule (ring_transpose(overlap=True): step t+1's
+    # permute issued before block t's FFT — bit-identical output,
+    # reordered issue), and Config.fused_wire swaps the per-block wire
+    # boundary for the fused Pallas kernels (_ring_hooks below).
+
+    def _ring_overlap(self, second: bool = False) -> bool:
+        snd = (self.config.resolved_snd2() if second
+               else self.config.send_method)
+        return snd is pm.SendMethod.RING_OVERLAP
+
+    def _ring_hooks(self, pipe_axes, inverse: bool = False):
+        """``(encode_fn, arrive_fn, pipe)`` for a ring exchange whose
+        arriving blocks run per-block FFTs over ``pipe_axes``: under the
+        fused wire (``Config.fused_wire_active``) the encode is the
+        one-pass Pallas pack and the arrival fuses the decode with the
+        FIRST pipelined DFT stage (remaining axes run the plain pipe);
+        otherwise ``(None, None, pipe)`` keeps the plain wire layer. The
+        returned ``pipe`` is always the FULL per-block pipeline — the
+        local block never touches the wire, so ring_transpose applies it
+        unfused regardless."""
+        pipe = self._ring_pipe(pipe_axes, inverse=inverse)
+        if not self.config.fused_wire_active():
+            return None, None, pipe
+        from ..ops import pallas_fft as plf
+        if not pipe_axes:
+            # No pipelined per-block FFT: the shared unpack-only hooks
+            # (the pencil/batched2d arrival).
+            enc_fn, arr_fn = plf.fused_ring_hooks(self.config)
+            return enc_fn, arr_fn, pipe
+        from ..parallel.transpose import wire_complex_dtype
+        cdt = wire_complex_dtype(self.config.double_prec)
+        norm, st = self.config.norm, self._mxu_st
+        first_ax, rest = pipe_axes[0], tuple(pipe_axes[1:])
+        rest_pipe = self._ring_pipe(rest, inverse=inverse)
+
+        def arrive(b):
+            b = plf.decode_fft_fused(b, cdt, first_ax, inverse=inverse,
+                                     norm=norm, settings=st)
+            return rest_pipe(b) if rest_pipe is not None else b
+
+        return plf.wire_encode_fused, arrive, pipe
 
     def _ring_pipe(self, axes, inverse: bool = False):
         """Shape-preserving per-block FFT pipeline over ``axes`` (None when
@@ -527,14 +570,17 @@ class SlabFFTPlan(DistFFTPlan):
         s, norm, g = self._seq, self.config.norm, self.global_size
         be, st = self.config.fft_backend, self._mxu_st
         first = self._fwd_parts()[0]
-        pipe = self._ring_pipe(tuple(a for a in s.post_axes if a != 0))
+        enc_fn, arr_fn, pipe = self._ring_hooks(
+            tuple(a for a in s.post_axes if a != 0))
         after = tuple(a for a in s.post_axes if a == 0)
         sa, nx = s.split_axis, g.nx
         wire = self.config.wire_dtype
+        overlap = self._ring_overlap()
 
         def body(xl):
             y = ring_transpose(first(xl), SLAB_AXIS, sa, 0, pipeline_fn=pipe,
-                               wire=wire)
+                               wire=wire, overlap=overlap,
+                               encode_fn=enc_fn, arrive_fn=arr_fn)
             y = slice_axis_to(y, 0, nx)
             for a in after:
                 y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
@@ -561,13 +607,15 @@ class SlabFFTPlan(DistFFTPlan):
         pipe_axes = tuple(a for a in reversed(s.pre_axes) if a != sa)
         if complex_mode and s.r2c_axis != sa:
             pipe_axes = pipe_axes + (s.r2c_axis,)
-        pipe = self._ring_pipe(pipe_axes, inverse=True)
+        enc_fn, arr_fn, pipe = self._ring_hooks(pipe_axes, inverse=True)
         after = tuple(a for a in reversed(s.pre_axes) if a == sa)
         wire = self.config.wire_dtype
+        overlap = self._ring_overlap()
 
         def body(cl):
             y = ring_transpose(first(cl), SLAB_AXIS, 0, sa, pipeline_fn=pipe,
-                               wire=wire)
+                               wire=wire, overlap=overlap,
+                               encode_fn=enc_fn, arrive_fn=arr_fn)
             y = slice_axis_to(y, sa, split_ext)
             for a in after:
                 y = lf.ifft(y, axis=a, norm=norm, backend=be, settings=st)
@@ -647,14 +695,15 @@ class SlabFFTPlan(DistFFTPlan):
         ALL2ALL rendering is the real chunked path, so a P2P+STREAMS
         config is an honest no-op rather than a mismeasured variant.
 
-        ``SendMethod.RING`` renders the exchange as the ``P-1``-step
-        ``lax.ppermute`` ring (``_ring_fwd_body``/``_ring_inv_body``). A
-        ring is only expressible as an explicit shard_map program, so RING
-        owns the rendering regardless of ``comm`` (params.py contract:
-        GSPMD delegation has no ppermute analog)."""
+        ``SendMethod.RING`` / ``RING_OVERLAP`` render the exchange as the
+        ``P-1``-step ``lax.ppermute`` ring (``_ring_fwd_body``/
+        ``_ring_inv_body``; RING_OVERLAP on the double-buffered schedule).
+        A ring is only expressible as an explicit shard_map program, so
+        the ring renderings own the exchange regardless of ``comm``
+        (params.py contract: GSPMD delegation has no ppermute analog)."""
         first, xpose, last = parts
         mesh = self.mesh
-        if self.config.send_method is pm.SendMethod.RING:
+        if self.config.send_method.is_ring:
             body = self._ring_fwd_body() if forward else self._ring_inv_body()
             return jax.shard_map(body, mesh=mesh, in_specs=in_spec,
                                  out_specs=out_spec)

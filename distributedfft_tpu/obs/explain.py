@@ -21,8 +21,11 @@ config + shape:
   (never executed) and ``microbench.async_collective_counts`` reports the
   collective / async-start / convert instance counts;
 * roofline expectation (``evalkit/roofline.py``): nominal FFT flops, the
-  MXU flops the matmul backend would issue, and the v5e-effective-peak
-  ideal time.
+  MXU flops the matmul backend would issue, the v5e-effective-peak ideal
+  time, and the tracked ``roofline_fraction`` for this size from the
+  committed BENCH_DETAILS.json "roofline" block (ISSUE 10's gate);
+* overlap schedule for ring-rendered exchanges (Ring / RingOverlap):
+  blocks, revolving buffers, and the wire bytes in flight per device.
 
 Examples::
 
@@ -72,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--wire-dtype", "-wire", default="native",
                     choices=("native", "bf16", "auto"))
     ap.add_argument("--wire-error-budget", type=float, default=None)
+    ap.add_argument("--fused-wire", action="store_true",
+                    help="explain the fused Pallas wire-kernel rendering "
+                         "(active on Ring/RingOverlap + bf16 wire only)")
     ap.add_argument("--guards", default=None,
                     choices=("off", "check", "enforce"),
                     help="explain the plan's resilience posture under this "
@@ -105,9 +111,17 @@ def _fmt_bytes(n: int) -> str:
     return f"{n} B"
 
 
-def _rendering(comm, send, opt, p: int) -> str:
+def _rendering(comm, send, opt, p: int, fused_wire: bool = False) -> str:
     """One-line resolved rendering of a single transpose."""
     from .. import params as pm
+    if send is pm.SendMethod.RING_OVERLAP:
+        steps = f"{p - 1} distinct lax.ppermute step" \
+            + ("s" if p > 2 else "")
+        fused = (", fused Pallas wire kernels (encode-pack / decode+FFT)"
+                 if fused_wire else "")
+        return (f"ring-overlap — {steps} on the DOUBLE-BUFFERED schedule "
+                "(step t+1's permute issued before block t's FFT; "
+                f"bit-identical to Ring, reordered issue{fused})")
     if send is pm.SendMethod.RING:
         steps = f"{p - 1} distinct lax.ppermute step" \
             + ("s" if p > 2 else "")
@@ -147,6 +161,29 @@ def _wire_lines(shapes, cdt, cfg) -> list:
         lines.append(f"  lossy: ~2e-3 max rel err per crossing; budget "
                      f"{cfg.resolved_wire_budget():.0e} "
                      "(README 'wire dtype')")
+    return lines
+
+
+def _schedule_lines(xmeta, cdt, cfg) -> list:
+    """Overlap-schedule block for ring-rendered exchanges (ISSUE 10):
+    blocks (= ring steps), revolving buffers, and the per-device wire
+    bytes in flight — ``transpose.ring_schedule`` over the exact padded
+    payload each exchange moves. Empty when no exchange is a ring."""
+    from .. import params as pm
+    from ..parallel.transpose import ring_schedule
+    lines = []
+    for label, shape, p, snd in xmeta:
+        if not snd.is_ring:
+            continue
+        sch = ring_schedule(shape, cdt, cfg.wire_dtype, p,
+                            overlap=snd is pm.SendMethod.RING_OVERLAP)
+        lines.append(
+            f"  {label}: {sch['steps']} block(s) of "
+            f"{_fmt_bytes(sch['block_wire_bytes'])} on the wire, "
+            f"{sch['buffers']} revolving buffer(s), "
+            f"{_fmt_bytes(sch['bytes_in_flight'])} in flight per device "
+            f"(mesh total {_fmt_bytes(sch['total_wire_bytes'])}, the "
+            f"(P-1)/P ring discount)")
     return lines
 
 
@@ -310,6 +347,26 @@ def _roofline_lines(args, kind: str, backend: str) -> list:
     lines.append(f"  v5e effective peak @high: {peak:.1f} TFLOPS -> ideal "
                  f"matmul roundtrip >= {ideal_ms:.2f} ms "
                  "(100% MXU; backend here: " + backend + ")")
+    # Predicted roofline_fraction (ISSUE 10 gate): the fraction a
+    # measurement of this workload would score is ideal_ms/measured_ms;
+    # quote the TRACKED value from the committed BENCH_DETAILS.json
+    # "roofline" block when a row for this size exists (nothing here
+    # executes — bench.py is the measurement side of the gate).
+    key = f"{nx}^2x{nz}" if kind == "batched" else str(nx)
+    tracked = rl.tracked_fractions()
+    rec = tracked.get(key) or tracked.get(f"{key}^3")
+    if rec:
+        lines.append(
+            f"  roofline_fraction (tracked): {rec['roofline_fraction']} "
+            f"at ideal {rec['ideal_ms']} ms ({rec['model']}, "
+            f"{rec.get('mode', 'roundtrip')}; committed "
+            "BENCH_DETAILS.json — a perf PR must move this, CI fails a "
+            ">10% regression)")
+    else:
+        lines.append(
+            f"  roofline_fraction: predicted ideal/measured — ideal "
+            f"{ideal_ms:.2f} ms at the 4mm bound; no tracked row for "
+            f"{key!r} in BENCH_DETAILS.json (run bench.py to record one)")
     return lines
 
 
@@ -388,6 +445,7 @@ def main(argv=None) -> int:
         streams_chunks=args.streams_chunks,
         wire_dtype=pm.parse_wire_dtype(args.wire_dtype),
         wire_error_budget=args.wire_error_budget,
+        fused_wire=bool(args.fused_wire),
         guards=args.guards,
         wisdom_path=args.wisdom, use_wisdom=not args.no_wisdom)
 
@@ -459,6 +517,7 @@ def main(argv=None) -> int:
 
         out.append("fft sequence:")
         xshapes = []  # (label, exchanged global payload shape)
+        xmeta = []    # (label, payload shape, mesh axis size, send method)
         if kind == "slab":
             s = plan._seq
             first = ("C2C" if transform == "c2c" else "R2C") \
@@ -470,6 +529,8 @@ def main(argv=None) -> int:
                 out.append(f"  exchange: scatter {'xyz'[s.split_axis]} -> "
                            "gather x")
                 xshapes.append(("transpose", plan.output_padded_shape))
+                xmeta.append(("transpose", plan.output_padded_shape, ranks,
+                              cfg.send_method))
             out.append("  stage 2: C2C "
                        + ",".join("xyz"[a] for a in s.post_axes))
         elif kind == "pencil":
@@ -479,12 +540,16 @@ def main(argv=None) -> int:
                 t1_shape = (plan._nx_p1, plan._ny_p2, plan._nzc_p2)
                 out.append("  exchange 1 (p2 axis): scatter z -> gather y")
                 xshapes.append(("transpose 1", t1_shape))
+                xmeta.append(("transpose 1", t1_shape, plan.p2,
+                              cfg.send_method))
             if dims >= 2:
                 out.append("  stage 2: C2C y")
             if dims >= 3 and ranks > 1:
                 t2_shape = (plan._nx_p1, plan._ny_p1, plan._nzc_p2)
                 out.append("  exchange 2 (p1 axis): scatter y -> gather x")
                 xshapes.append(("transpose 2", t2_shape))
+                xmeta.append(("transpose 2", t2_shape, plan.p1,
+                              cfg.resolved_snd2()))
             if dims >= 3:
                 out.append("  stage 3: C2C x")
         else:
@@ -492,9 +557,10 @@ def main(argv=None) -> int:
                                         else "R2C y") + " (per plane)")
             if args.shard == "x" and ranks > 1:
                 out.append("  exchange: scatter spectral y -> gather x")
-                xshapes.append(("transpose",
-                                (plan._batch_pad, plan._nx_pad,
-                                 plan._nys_pad)))
+                bshape = (plan._batch_pad, plan._nx_pad, plan._nys_pad)
+                xshapes.append(("transpose", bshape))
+                xmeta.append(("transpose", bshape, ranks,
+                              cfg.send_method))
                 out.append("  stage 2: C2C x (per plane)")
             else:
                 out.append("  stage 2: C2C x (per plane; batch sharding "
@@ -510,23 +576,31 @@ def main(argv=None) -> int:
             out.append(f"  transpose 1: comm {cfg.comm_method.value} snd "
                        f"{cfg.send_method.value} -> "
                        + _rendering(cfg.comm_method, cfg.send_method,
-                                    cfg.opt, plan.p2))
+                                    cfg.opt, plan.p2,
+                                    cfg.fused_wire_active()))
             if dims >= 3:
                 out.append(f"  transpose 2: comm "
                            f"{cfg.resolved_comm2().value} snd "
                            f"{cfg.resolved_snd2().value} -> "
                            + _rendering(cfg.resolved_comm2(),
                                         cfg.resolved_snd2(), cfg.opt,
-                                        plan.p1))
+                                        plan.p1,
+                                        cfg.fused_wire_active(True)))
         else:
             out.append(f"  comm {cfg.comm_method.value} snd "
                        f"{cfg.send_method.value} opt {cfg.opt} -> "
                        + _rendering(cfg.comm_method, cfg.send_method,
-                                    cfg.opt, ranks))
+                                    cfg.opt, ranks,
+                                    cfg.fused_wire_active()))
         out.append(f"  local FFT backend: {cfg.fft_backend}"
                    + (f" (mxu_precision={cfg.mxu_precision}, "
                       f"mxu_direct_max={cfg.mxu_direct_max})"
                       if cfg.fft_backend.startswith("matmul") else ""))
+
+        sched = _schedule_lines(xmeta, cdt, cfg)
+        if sched:
+            out.append("overlap schedule (ring exchange, per device):")
+            out.extend(sched)
 
         out.append("wire:")
         if xshapes:

@@ -16,7 +16,14 @@ DFT as dense matrix multiplication so the transform runs on the MXU:
   i.e. reshape -> DFT matmul (n2) -> twiddle multiply -> DFT matmul (n1) ->
   reshape, recursing when a factor still exceeds ``DIRECT_MAX``. The matmul
   count is O(n * (n1+n2)) flops — more than O(n log n), but on the MXU's
-  dense-matmul throughput rather than the VPU's.
+  dense-matmul throughput rather than the VPU's. The factor choice is the
+  MXU-deep split (``_split_for``): whenever both factors can stay on the
+  direct path, the dominant factor is the largest divisor <= ``direct_max``
+  (2048 -> 4x512, 4096 -> 8x512) rather than the balanced pair (32x64,
+  64x64), so intermediate lengths keep the systolic array's full
+  contraction depth — the large-axis extension of the direct table, driven
+  by the measured direct-beats-balanced 1024^3 result (652 vs 228
+  GFLOPS/chip, session_r5 2026-07-31).
 
 The matmul is the hot op of this backend; it lowers to plain XLA
 ``dot_general`` so the compiler fuses the twiddle multiplies into the
@@ -214,6 +221,44 @@ def _split(n: int) -> Tuple[int, int]:
     return 1, n
 
 
+@functools.lru_cache(maxsize=None)
+def _split_wide(n: int, direct_max: int) -> Tuple[int, int]:
+    """MXU-deep factorization n = n1*n2 with n2 the LARGEST divisor of
+    ``n`` not exceeding ``direct_max`` (and n1 = n/n2). Returns (1, n)
+    when no such divisor > 1 exists (primes)."""
+    for n2 in range(min(int(direct_max), n - 1), 1, -1):
+        if n % n2 == 0:
+            return n // n2, n2
+    return 1, n
+
+
+@functools.lru_cache(maxsize=None)
+def _split_for(n: int, direct_max: int) -> Tuple[int, int]:
+    """The factorization the four-step dispatch actually uses for an
+    axis of length ``n > direct_max`` — the large-axis extension of the
+    direct table (ISSUE 10 tentpole c).
+
+    The balanced split minimizes MACs (n1+n2 smallest) but starves the
+    MXU at intermediate lengths: 2048 under the default ``DIRECT_MAX``
+    factors as 32x64, two contractions well below the systolic array's
+    128-deep pipeline — exactly the regime where the measured all-direct
+    1024^3 result (652 vs 228 GFLOPS, session_r5 2026-07-31) showed
+    depth beating flop count ~3x. So when a factorization with BOTH
+    factors on the direct-DFT matmul path exists, prefer the one whose
+    dominant factor is as DEEP as possible: n2 = the largest divisor
+    <= direct_max (2048 -> 4x512, 4096 -> 8x512 at the default table;
+    2048 -> 2x1024 under the raced direct_max=1024), so the contraction
+    carrying ~all the volume runs at full direct depth and 2048/4096
+    axes stop falling off the MXU. When the deep co-factor n1 would
+    itself exceed ``direct_max`` (n > direct_max^2, or divisor
+    structure forbids it), fall back to the balanced split and let the
+    recursion handle the large factor."""
+    n1, n2 = _split_wide(n, direct_max)
+    if 1 < n1 <= direct_max:
+        return n1, n2
+    return _split(n)
+
+
 # ---------------------------------------------------------------------------
 # Core transform along the LAST axis
 # ---------------------------------------------------------------------------
@@ -385,7 +430,7 @@ def _fft_last(x, inverse: bool):
         return _fft_radix2(x, inverse)
     if n <= st.direct_max:
         return _matmul_F(x, _dft_np(n, inverse, dbl))
-    n1, n2 = _split(n)
+    n1, n2 = _split_for(n, st.direct_max)
     if n1 == 1:  # prime length: direct full-size matmul
         return _matmul_F(x, _dft_np(n, inverse, dbl))
     if st.fourstep_einsum and n1 <= st.direct_max and n2 <= st.direct_max:
@@ -408,7 +453,7 @@ def _rfft_last(x):
     st = current_settings()
     if n <= st.direct_max:
         return _rmatmul_F(x, _dft_np(n, False, dbl)[:, :n_out])
-    n1, n2 = _split(n)
+    n1, n2 = _split_for(n, st.direct_max)
     if n1 == 1:
         return _rmatmul_F(x, _dft_np(n, False, dbl)[:, :n_out])
     if st.fourstep_einsum and n1 <= st.direct_max and n2 <= st.direct_max:

@@ -649,7 +649,7 @@ def _fft_last(x, inverse: bool):
         return mx._fft_last(x, inverse)
     if n <= mx.DIRECT_MAX:
         return _stage(x, mx._dft_np(n, inverse, False))
-    n1, n2 = mx._split(n)
+    n1, n2 = mx._split_for(n, mx.DIRECT_MAX)
     if n1 == 1:  # prime length
         if n <= _N_MAX:
             return _stage(x, mx._dft_np(n, inverse, False))
@@ -672,7 +672,7 @@ def _rfft_last(x):
         return mx._rfft_last(x)
     if n <= mx.DIRECT_MAX:
         return _stage(x, mx._dft_np(n, False, False)[:, :n_out])
-    n1, n2 = mx._split(n)
+    n1, n2 = mx._split_for(n, mx.DIRECT_MAX)
     if n1 == 1:
         if n <= _N_MAX:
             return _stage(x, mx._dft_np(n, False, False)[:, :n_out])
@@ -687,6 +687,206 @@ def _rfft_last(x):
     d = _fft_last(jnp.swapaxes(c, -1, -2), False)
     full = jnp.swapaxes(d, -1, -2).reshape(x.shape[:-1] + (n,))
     return full[..., :n_out]
+
+
+# ---------------------------------------------------------------------------
+# Fused wire kernels (ISSUE 10, the overlap engine's HBM lever).
+#
+# The ring renderings encode each TRAVELLING block to the bf16 planar wire
+# immediately before its ppermute and decode + FFT it on arrival
+# (parallel/transpose.ring_transpose). Composed from jnp ops, that boundary
+# costs extra HBM round-trips on TPU whenever a pallas_call sits nearby:
+# the custom-call boundary stops XLA from fusing the split/cast/stack into
+# the neighboring kernels (the exact structural limit the module verdict
+# above documents), so the payload is materialized once in f32 planes and
+# again in bf16. These kernels collapse the boundary:
+#
+# * ``wire_encode_fused``  — planar split + bf16 cast + pack in ONE kernel
+#   pass (the send side; there is structurally no per-block FFT to fuse
+#   with here — the last pre-exchange FFT always runs along the split
+#   axis, so it cannot commute past the per-peer chunking);
+# * ``decode_fft_fused``   — bf16 unpack + the first pipelined per-block
+#   DFT matmul stage in ONE kernel (the receive side): the planes convert
+#   to f32 inside VMEM and feed the MXU contraction directly, so the
+#   decoded f32 image never lands in HBM;
+# * ``wire_decode_fused``  — unpack-only variant for blocks with no
+#   pipelined FFT (every pencil/batched2d ring block, slab ZY_Then_X).
+#
+# Numerics contract: the jnp fallbacks (off-TPU, f64, oversized axes,
+# interpret-mode shard_map) are EXACTLY the unfused compositions, and the
+# kernel paths agree with them to the wire's documented bf16 bound (the
+# fused DFT runs at the backend's HIGH three-pass emulation; the bf16 wire
+# quantization dominates — tests/test_overlap.py pins the bound). The
+# encode/decode formulas mirror ``parallel/transpose.wire_encode``/
+# ``wire_decode`` and must stay in sync with them.
+# ---------------------------------------------------------------------------
+
+
+def _enc_pack_kernel(xr_ref, xi_ref, yr_ref, yi_ref):
+    """Planar split + bf16 cast ("encode + pack") in one VMEM pass."""
+    yr_ref[:] = xr_ref[:].astype(jnp.bfloat16)
+    yi_ref[:] = xi_ref[:].astype(jnp.bfloat16)
+
+
+def _dec_unpack_kernel(pr_ref, pi_ref, yr_ref, yi_ref):
+    """bf16 planes -> f32 planes (decode/unpack) in one VMEM pass."""
+    yr_ref[:] = pr_ref[:].astype(jnp.float32)
+    yi_ref[:] = pi_ref[:].astype(jnp.float32)
+
+
+def _dec_cmatmul_kernel(pr_ref, pi_ref, fr_ref, fi_ref, yr_ref, yi_ref):
+    """Fused decode + complex DFT matmul: the bf16 wire planes convert to
+    f32 inside VMEM and feed the MXU contraction directly."""
+    xr = _planes(pr_ref[:].astype(jnp.float32))
+    xi = _planes(pi_ref[:].astype(jnp.float32))
+    fr, fi = _planes(fr_ref[:]), _planes(fi_ref[:])
+    yr_ref[:] = _dot2(xr, fr) - _dot2(xi, fi)
+    yi_ref[:] = _dot2(xr, fi) + _dot2(xi, fr)
+
+
+def _wire_planes_encode_jnp(x):
+    """The unfused encode (== transpose.wire_encode's formula)."""
+    return jnp.stack([jnp.real(x), jnp.imag(x)]).astype(jnp.bfloat16)
+
+
+def _wire_planes_decode_jnp(y, dtype):
+    """The unfused decode (== transpose.wire_decode's formula)."""
+    f = (jnp.float64 if mx._is_double(dtype) else jnp.float32)
+    z = y.astype(f)
+    return lax.complex(z[0], z[1])
+
+
+def _wire_kernel_usable(x) -> bool:
+    """Whether the fused wire kernels can run on this value: a pltpu
+    build, f32-family data, and not the interpret-mode shard_map corner
+    (same contract as ``_call_stage``'s fallback)."""
+    return (_HAS_PLTPU and not mx._is_double(x.dtype)
+            and not (_interpret() and (_vma(x) or _under_rewrite())))
+
+
+def _plane_pass(kern, planes, out_dtype):
+    """Run an elementwise two-plane kernel over (M, n)-reshaped planes
+    with the shared row-block grid."""
+    shape = planes[0].shape
+    p2 = [p.reshape((-1, shape[-1])) for p in planes]
+    m, n = p2[0].shape
+    tb = _row_block(1)
+    m_pad = tb * ((m + tb - 1) // tb)
+    if m_pad != m:
+        p2 = [jnp.pad(p, [(0, m_pad - m), (0, 0)]) for p in p2]
+    vma = _vma(planes[0])
+    spec = pl.BlockSpec((tb, n), lambda i: (i, 0))
+    args = _lift_vma(p2, vma)
+    yr, yi = pl.pallas_call(
+        kern,
+        grid=(m_pad // tb,),
+        in_specs=[spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[_sds((m_pad, n), out_dtype, vma)] * 2,
+        interpret=_interpret(),
+    )(*args)
+    if m_pad != m:
+        yr, yi = yr[:m], yi[:m]
+    return yr.reshape(shape), yi.reshape(shape)
+
+
+def fused_ring_hooks(config, snd=None):
+    """``(encode_fn, arrive_fn)`` for a ring exchange whose arriving
+    blocks run NO pipelined per-block FFTs (every pencil and batched-2D
+    ring block): the one-pass encode-pack and the unpack-only arrival.
+    ``(None, None)`` — the plain wire layer — when the fused wire is
+    inactive for this transpose (``Config.fused_wire_for``; ``snd``
+    defaults to the config's first-transpose send method). Slab's
+    pipelined arrivals build their decode+FFT hook via
+    ``SlabFFTPlan._ring_hooks`` instead; both share this module's
+    kernels and the Config predicate, so the activation condition lives
+    in exactly one place."""
+    active = (config.fused_wire_for(snd) if snd is not None
+              else config.fused_wire_active())
+    if not active:
+        return None, None
+    from ..parallel.transpose import wire_complex_dtype
+    cdt = wire_complex_dtype(config.double_prec)
+    return wire_encode_fused, (lambda b: wire_decode_fused(b, cdt))
+
+
+def wire_encode_fused(x):
+    """Complex array -> planar (real, imag) bf16 pair along a new leading
+    axis, as ONE kernel pass (the ring's per-travelling-block encode +
+    pack). Fallback (off-TPU / f64 / interpret shard_map): the exact
+    unfused formula — bit-identical to ``transpose.wire_encode``."""
+    if not (jnp.iscomplexobj(x) and _wire_kernel_usable(x)):
+        return _wire_planes_encode_jnp(x)
+    yr, yi = _plane_pass(_enc_pack_kernel,
+                         [jnp.real(x.astype(jnp.complex64)),
+                          jnp.imag(x.astype(jnp.complex64))],
+                         jnp.bfloat16)
+    return jnp.stack([yr, yi])
+
+
+def wire_decode_fused(y, dtype):
+    """Planar bf16 pair -> complex ``dtype`` as ONE kernel pass (the
+    unpack-only arrival path of ring blocks with no pipelined FFT).
+    Fallback: the exact unfused formula (== ``transpose.wire_decode``)."""
+    if mx._is_double(dtype) or not _wire_kernel_usable(y):
+        return _wire_planes_decode_jnp(y, dtype)
+    zr, zi = _plane_pass(_dec_unpack_kernel, [y[0], y[1]], jnp.float32)
+    return lax.complex(zr, zi)
+
+
+def decode_fft_fused(y, dtype, axis: int, *, inverse: bool = False,
+                     norm: FFTNorm = FFTNorm.NONE, settings=None):
+    """Fused wire decode + per-block DFT along ``axis`` of the decoded
+    block: the bf16 planes feed the MXU contraction inside VMEM, so the
+    decoded f32 image never round-trips HBM. The DFT is the direct
+    matmul (the fusion IS the matmul — regardless of the plan's
+    ``fft_backend``); axes past ``_N_MAX`` and every fallback condition
+    run the exact unfused composition ``mxu_fft.(i)fft(decode(y))``
+    under the same settings."""
+    with mx.use_settings(settings):
+        n = y.shape[1:][axis]
+        # The f64 guard keys on the TARGET dtype, not the payload (the
+        # bf16 planes are never 'double'): a double_prec plan's arrived
+        # blocks must restore complex128 via the unfused composition,
+        # not silently drop to the f32 kernel.
+        if (mx._is_double(dtype) or not _wire_kernel_usable(y)
+                or n > _N_MAX):
+            c = _wire_planes_decode_jnp(y, dtype)
+            return (mx.ifft if inverse else mx.fft)(c, axis=axis, norm=norm)
+        # Planes to (M, n) rows with the DFT axis last (the same relayout
+        # the unfused lf.fft pays), then one fused kernel.
+        pr = jnp.moveaxis(y[0], axis, -1)
+        pi = jnp.moveaxis(y[1], axis, -1)
+        shape = pr.shape
+        pr2, pi2 = pr.reshape((-1, n)), pi.reshape((-1, n))
+        m = pr2.shape[0]
+        tb = _row_block(1)
+        m_pad = tb * ((m + tb - 1) // tb)
+        if m_pad != m:
+            pr2 = jnp.pad(pr2, [(0, m_pad - m), (0, 0)])
+            pi2 = jnp.pad(pi2, [(0, m_pad - m), (0, 0)])
+        fr, fi = _f32_planes(mx._dft_np(n, inverse, False))
+        vma = _vma(y)
+        row_spec = pl.BlockSpec((tb, n), lambda i: (i, 0))
+        const_spec = pl.BlockSpec((n, n), lambda i: (0, 0))
+        args = _lift_vma([pr2, pi2, jnp.asarray(fr), jnp.asarray(fi)], vma)
+        yr, yi = pl.pallas_call(
+            _dec_cmatmul_kernel,
+            grid=(m_pad // tb,),
+            in_specs=[row_spec, row_spec, const_spec, const_spec],
+            out_specs=[row_spec, row_spec],
+            out_shape=[_sds((m_pad, n), jnp.float32, vma)] * 2,
+            cost_estimate=pl.CostEstimate(
+                flops=4 * 2 * m_pad * n * n, transcendentals=0,
+                bytes_accessed=2 * m_pad * n * 2 + 4 * (m_pad + n) * n * 2),
+            interpret=_interpret(),
+        )(*args)
+        if m_pad != m:
+            yr, yi = yr[:m], yi[:m]
+        out = lax.complex(yr, yi).reshape(shape)
+        scale = (mx._inv_scale(n, norm) if inverse
+                 else mx._fwd_scale(n, norm))
+        return jnp.moveaxis(mx._scaled(out, scale), -1, axis)
 
 
 # ---------------------------------------------------------------------------

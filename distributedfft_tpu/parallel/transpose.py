@@ -274,7 +274,8 @@ def _chunked_reshard_impl(x, target, axis: int, k: int):
 
 
 def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
-                   pipeline_fn=None, wire: str = WIRE_NATIVE):
+                   pipeline_fn=None, wire: str = WIRE_NATIVE,
+                   overlap: bool = False, encode_fn=None, arrive_fn=None):
     """Ring-pipelined rendering of the tiled ``lax.all_to_all`` exchange:
     the global transpose decomposed into ``P-1`` ``lax.ppermute`` steps
     (rotation offset t sends the block destined for peer ``r+t`` directly,
@@ -312,19 +313,44 @@ def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
     both satisfy the same per-element error bound, the ring merely keeps
     1/P of the data lossless for free.
 
+    ``overlap`` selects the DOUBLE-BUFFERED schedule
+    (``SendMethod.RING_OVERLAP``): step t+1's ``ppermute`` is issued
+    before block t's ``pipeline_fn`` is traced, with two revolving
+    buffers (the in-flight block and the computing block). Every
+    per-block op — slice, encode, taint, permute, decode, pipeline — is
+    IDENTICAL to the ``overlap=False`` schedule, only the issue order
+    changes, so the output is bit-identical to RING while a scheduler
+    that honors program order (the TPU async start/done lowering) can
+    keep one wire transfer in flight under every block's compute
+    instead of alternating permute -> FFT -> permute.
+
+    ``encode_fn``/``arrive_fn`` are the FUSED-WIRE hooks
+    (``Config.fused_wire``; ``ops/pallas_fft`` fused-wire kernels):
+    ``encode_fn`` replaces ``wire_encode`` on each travelling block
+    (only consulted when the wire is active), and ``arrive_fn`` replaces
+    the ``wire_decode`` + ``pipeline_fn`` pair on each ARRIVING block
+    (the local block always takes plain ``pipeline_fn`` — it never
+    touches the wire, so there is nothing to fuse with). Defaults
+    (None) keep the plain wire layer.
+
     The ``split_axis`` extent must be divisible by the mesh axis size
     (plans pad). Must be called inside ``shard_map`` over ``axis_name``.
     """
     obs.metrics.inc("wire.exchanges_traced")
     obs.metrics.gauge("wire.bytes_per_transpose",
                       wire_nbytes(x.shape, x.dtype, wire))
-    with obs.span("exchange.ring", axis=axis_name, wire=wire):
+    with obs.span("exchange.ring", axis=axis_name, wire=wire,
+                  overlap=bool(overlap)):
         return _ring_transpose_impl(x, axis_name, split_axis, concat_axis,
-                                    pipeline_fn=pipeline_fn, wire=wire)
+                                    pipeline_fn=pipeline_fn, wire=wire,
+                                    overlap=overlap, encode_fn=encode_fn,
+                                    arrive_fn=arrive_fn)
 
 
 def _ring_transpose_impl(x, axis_name: str, split_axis: int,
-                         concat_axis: int, *, pipeline_fn, wire: str):
+                         concat_axis: int, *, pipeline_fn, wire: str,
+                         overlap: bool = False, encode_fn=None,
+                         arrive_fn=None):
     """``ring_transpose`` proper (split out so the obs span wraps one
     call site)."""
     p = _axis_size(axis_name)
@@ -348,24 +374,52 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
         # every device runs the same program on its own rotation.
         return lax.dynamic_slice_in_dim(x, ((r + i) % p) * ch, ch, axis=s)
 
-    # Step 0 is the local block (peer r -> itself, no wire). Step t sends
-    # chunk r+t to peer r+t and receives peer (r-t)'s block for us; the
-    # received block is pipelined immediately, before step t+1's permute
-    # result is consumed.
-    blocks = [pipeline_fn(chunk(0))]
-    for t in range(1, p):
+    def send(t):
+        """Encode + taint + permute of step t's travelling block — the
+        wire side of one ring step, shared by both schedules so the
+        per-block ops cannot diverge between them."""
         perm = [(src, (src + t) % p) for src in range(p)]
         b = chunk(t)
         if wired:
-            b = wire_encode(b, wire)
+            b = wire_encode(b, wire) if encode_fn is None else encode_fn(b)
         # Fault-injection hook on each TRAVELLING block (the local block
         # never touches the wire, mirroring the encoding contract above);
         # identity without $DFFT_FAULT_SPEC.
         b = inject.taint_wire(b, "ring")
-        b = lax.ppermute(b, axis_name, perm)
+        return lax.ppermute(b, axis_name, perm)
+
+    def arrive(b):
+        """Decode + per-block pipeline of one ARRIVED block (the receive
+        side of a ring step); ``arrive_fn`` fuses the pair."""
+        if arrive_fn is not None:
+            return arrive_fn(b)
         if wired:
             b = wire_decode(b, x.dtype, wire)
-        blocks.append(pipeline_fn(b))
+        return pipeline_fn(b)
+
+    # Step 0 is the local block (peer r -> itself, no wire). Step t sends
+    # chunk r+t to peer r+t and receives peer (r-t)'s block for us.
+    if not overlap:
+        # RING: the received block is pipelined immediately, before step
+        # t+1's permute is issued.
+        blocks = [pipeline_fn(chunk(0))]
+        for t in range(1, p):
+            blocks.append(arrive(send(t)))
+    else:
+        # RING_OVERLAP: software pipeline with two revolving buffers.
+        # Step 1's permute is issued FIRST (its operand — chunk 1 —
+        # carries no dependency on any compute), the local block's FFTs
+        # trace under it, and inside the loop step t+1's permute is
+        # issued before block t's arrive-side compute, so each transfer
+        # can be in flight while the previous block computes. Same ops
+        # as RING in a reordered schedule — bit-identical output.
+        in_flight = send(1)
+        blocks = [pipeline_fn(chunk(0))]
+        for t in range(1, p):
+            current = in_flight
+            if t + 1 < p:
+                in_flight = send(t + 1)
+            blocks.append(arrive(current))
     # Reassemble in PEER order along the concat axis (tiled all_to_all
     # semantics: the block from peer j lands at concat slot j). Block t
     # came from peer (r - t) mod p, so peer order is the arrival order
@@ -378,6 +432,33 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
     merged = shp.pop(c)
     shp[c] *= merged
     return o.reshape(tuple(shp))
+
+
+def ring_schedule(payload_shape, dtype, wire: str, p: int,
+                  overlap: bool = False) -> dict:
+    """Static description of a ring exchange's schedule over a GLOBAL
+    padded payload of ``payload_shape`` (what ``dfft-explain`` prints for
+    a resolved RING/RING_OVERLAP plan): ``steps`` permutes per device,
+    ``buffers`` revolving receive buffers (2 under the double-buffered
+    overlap schedule, 1 for the plain ring), the per-device travelling
+    block's wire bytes (one P-th of the local shard — the unit in flight
+    on each step), the peak bytes in flight per device, and the total
+    wire bytes across the mesh (the ``(P-1)/P`` ring discount: the local
+    block never travels)."""
+    total = wire_nbytes(payload_shape, dtype, wire)
+    block = total // (p * p) if p > 1 else total
+    steps = max(0, p - 1)
+    return {
+        "steps": steps,
+        "buffers": 2 if overlap else 1,
+        "block_wire_bytes": block,
+        # One transfer in flight while the previous block computes: the
+        # overlap schedule holds two block-sized buffers live per device
+        # (the in-flight and the computing block); the plain ring holds
+        # one.
+        "bytes_in_flight": block * (2 if overlap else 1),
+        "total_wire_bytes": total * steps // p if p > 1 else 0,
+    }
 
 
 def realigned_pack_shape(shape, split_axis: int, p: int):

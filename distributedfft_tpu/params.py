@@ -31,6 +31,12 @@ On TPU the comm/send matrix collapses into *how the XLA program is built*:
   ``lax.ppermute`` steps XLA cannot re-fuse, with per-peer-block FFT
   compute pipelined between them — the overlap-capable rendering the
   STREAMS result motivated.
+* ``SendMethod.RING_OVERLAP`` -> the same ring with the loop restructured
+  as a DOUBLE-BUFFERED software pipeline: step t+1's permute is issued
+  BEFORE block t's per-block FFT is traced (two revolving buffers), so a
+  scheduler that respects program order can keep one transfer in flight
+  under every block's compute. Same block math in a reordered schedule —
+  bit-identical output to RING, pinned by tests/test_overlap.py.
   ``SYNC`` is the monolithic single-collective pipeline; ``MPI_TYPE``
   (zero-copy strided datatypes) has no analog under XLA -- packing is a
   fused transpose -- and is accepted as a benchmarking label alias of SYNC.
@@ -146,12 +152,24 @@ class SendMethod(enum.Enum):
     (HLO async-collective counts) actually fires. A ring is only
     expressible as an explicit ``shard_map`` program, so RING owns the
     exchange rendering regardless of ``comm_method`` (GSPMD delegation
-    has no ppermute analog)."""
+    has no ppermute analog).
+
+    ``RING_OVERLAP`` is RING's double-buffered schedule (the overlap
+    engine of ISSUE 10): the per-block loop is restructured so step
+    t+1's ``ppermute`` is issued before block t's per-block FFT, with
+    two revolving buffers carrying the in-flight and the computing
+    block. The per-block math is IDENTICAL to RING (bit-identical
+    output, pinned), only the issue order changes — which is exactly
+    what lets an asynchronous scheduler (TPU start/done pairs) hide
+    each transfer under the previous block's compute instead of
+    serializing permute -> FFT -> permute. Owns the rendering
+    regardless of ``comm_method``, like RING."""
 
     SYNC = "Sync"
     STREAMS = "Streams"
     MPI_TYPE = "MPI_Type"
     RING = "Ring"
+    RING_OVERLAP = "RingOverlap"
 
     @classmethod
     def parse(cls, s: "str | SendMethod") -> "SendMethod":
@@ -164,9 +182,19 @@ class SendMethod(enum.Enum):
             return cls.STREAMS
         if key == "ring":
             return cls.RING
+        if key in ("ringoverlap", "overlap", "ringovl"):
+            return cls.RING_OVERLAP
         if key in ("mpitype", "mpit", "type"):
             return cls.MPI_TYPE
         raise ValueError(f"unknown send method: {s!r}")
+
+    @property
+    def is_ring(self) -> bool:
+        """Both ppermute-ring renderings (RING and its double-buffered
+        RING_OVERLAP schedule) — the predicate the plan assemblers and
+        the contract/ladder layers share, so a new ring variant cannot
+        be wired into one of them only."""
+        return self in (SendMethod.RING, SendMethod.RING_OVERLAP)
 
 
 class FFTNorm(enum.Enum):
@@ -367,7 +395,7 @@ class Config:
     when the shape has a non-smooth axis — it would duplicate "xla"
     otherwise) and records the winner. ``comm_method=
     "auto"`` does the same for the whole comm x send x opt x streams-chunks
-    variant, the RING ring rendering included (ignoring the explicit
+    variant, the RING and RING_OVERLAP ring renderings included (ignoring the explicit
     ``send_method``/``opt`` fields — the race owns them). ``use_wisdom=False`` (CLI ``--no-wisdom``) never
     touches disk; "auto" then races per process.
 
@@ -389,8 +417,25 @@ class Config:
     stays within ``wire_error_budget`` (None -> 2e-2), and records the
     winner in the wisdom store. The encoding composes with every exchange
     rendering — default/opt1 ``lax.all_to_all``, the GSPMD boundary, and
-    the RING ppermute ring, which encodes per travelling block so
-    compression and overlap stack. Applies to both pencil transposes.
+    the RING/RING_OVERLAP ppermute rings, which encode per travelling
+    block so compression and overlap stack. Applies to both pencil
+    transposes.
+
+    ``fused_wire`` (opt-in, default False) renders the ring's per-block
+    wire boundary with the fused Pallas kernels (``ops/pallas_fft``
+    fused-wire section): the bf16 planar split + pack runs as ONE kernel
+    pass on the send side, and the decode + the first pipelined per-block
+    DFT stage fuse into one kernel on the receive side, so the travelling
+    payload never round-trips HBM between the wire cast and the
+    neighboring FFT matmul (``pallas_call`` is a custom-call boundary XLA
+    cannot fuse across — the one case where the hand kernel wins; see the
+    ``ops/pallas_fft.py`` module docstring). Only active on a ring
+    rendering (RING / RING_OVERLAP) with ``wire_dtype="bf16"``; inert
+    otherwise. Off-TPU the kernels fall back to the numerically
+    equivalent jnp composition, and the fused decode+FFT stage computes
+    its DFT as a matmul regardless of ``fft_backend`` (that IS the
+    fusion) — numerics vs the unfused path are bounded by the wire's
+    documented bf16 error (tests/test_overlap.py pins the bound).
 
     ``guards`` selects the in-graph numerical guards of the resilience
     layer (``resilience/guards.py``; CLI ``--guards``, env
@@ -448,6 +493,7 @@ class Config:
     streams_chunks: Optional[int] = None
     wire_dtype: str = "native"
     wire_error_budget: Optional[float] = None
+    fused_wire: bool = False
     guards: Optional[str] = None
     wisdom_path: Optional[str] = None
     use_wisdom: bool = True
@@ -502,6 +548,9 @@ class Config:
             raise ValueError(
                 f"wire_error_budget must be a positive number or None, "
                 f"got {self.wire_error_budget!r}")
+        if not isinstance(self.fused_wire, bool):
+            raise ValueError(
+                f"fused_wire must be a bool, got {self.fused_wire!r}")
         if self.guards is not None:
             # Canonicalized here rather than at resolution so a typo'd
             # mode fails at Config construction, not at first exec.
@@ -545,6 +594,23 @@ class Config:
     def resolved_streams_chunks(self) -> int:
         """Chunk count for the STREAMS pipelined transpose (None -> 4)."""
         return self.streams_chunks if self.streams_chunks is not None else 4
+
+    def fused_wire_for(self, snd: "SendMethod") -> bool:
+        """The fused-wire predicate for an exchange rendered by ``snd``:
+        opt-in ``fused_wire`` on a ring rendering (RING/RING_OVERLAP)
+        with the compressed bf16 wire — inert everywhere else (read
+        POST-resolution; an unresolved "auto" wire never reaches the
+        assemblers). The ONE activation condition every family and the
+        shared hook builder (``pallas_fft.fused_ring_hooks``) consult,
+        so the three assemblers cannot drift."""
+        return bool(self.fused_wire and snd.is_ring
+                    and self.wire_dtype == "bf16")
+
+    def fused_wire_active(self, second: bool = False) -> bool:
+        """``fused_wire_for`` of this plan's own (first or second)
+        transpose rendering."""
+        return self.fused_wire_for(self.resolved_snd2() if second
+                                   else self.send_method)
 
     def resolved_wire_budget(self) -> float:
         """Max rel error the 'auto' wire race accepts from a compressed

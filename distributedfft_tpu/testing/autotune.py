@@ -296,6 +296,8 @@ class CommCandidate:
         name = getattr(self.send, "name", None)
         if name == "RING":
             tag += "/ring"
+        elif name == "RING_OVERLAP":
+            tag += "/ring-ovl"
         elif name == "STREAMS":
             tag += f"/streams{self.chunks}"
         if self.wire not in (None, "native"):
@@ -462,10 +464,15 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
     races the STREAMS chunked-pipelined transpose at every piece count in
     ``streams_chunks`` (the reference's ``-snd`` dimension), plus ONE
     ``SendMethod.RING`` candidate (the ppermute ring rendering,
-    ``parallel/transpose.ring_transpose``). The ring owns the exchange
-    rendering regardless of comm_method and ignores the opt layout axis
-    (both are properties of the ``lax.all_to_all`` it replaces), so it
-    races once — under the first opt's ALL2ALL point — not per cell.
+    ``parallel/transpose.ring_transpose``) and ONE ``RING_OVERLAP``
+    candidate (the double-buffered ring schedule — bit-identical output,
+    reordered issue; on a backend whose scheduler honors the reordering
+    it times differently, so it races as its own cell and the wisdom
+    store records whichever schedule won — store schema v4). The rings
+    own the exchange rendering regardless of comm_method and ignore the
+    opt layout axis (both are properties of the ``lax.all_to_all`` they
+    replace), so each races once — under the first opt's ALL2ALL point —
+    not per cell.
     PEER2PEER points are not crossed — GSPMD re-fuses piece reshards into
     one collective (measured, ``models/slab._assemble_pure``), so a
     P2P+STREAMS candidate would mismeasure a program identical to SYNC.
@@ -517,11 +524,17 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
                                             chunks=int(k))
                               for k in streams_chunks if k and int(k) > 1]
                     if opt == opts[0]:
-                        # Ring is opt- and comm-agnostic (it replaces the
-                        # all_to_all those knobs parameterize): one
-                        # candidate, not a duplicate per matrix cell.
+                        # The rings are opt- and comm-agnostic (they
+                        # replace the all_to_all those knobs
+                        # parameterize): one candidate each, not a
+                        # duplicate per matrix cell. RING_OVERLAP is a
+                        # distinct cell — same math, reordered schedule,
+                        # different time wherever the scheduler can
+                        # overlap.
                         cands.append(CommCandidate(cc1, cc2, opt,
                                                    send=SendMethod.RING))
+                        cands.append(CommCandidate(
+                            cc1, cc2, opt, send=SendMethod.RING_OVERLAP))
     if race_wire:
         # Natives first (the twins' error reference), then the bf16 twin
         # of every cell. Explicit wire on both sides: the raced axis is

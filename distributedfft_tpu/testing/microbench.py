@@ -169,10 +169,17 @@ def overlap_race(global_shape, p: int, chunk_counts=(2, 4), k: int = 4,
     scale = 1.0 / float(g.n_total)
     variants = [("sync", None)] + [(f"streams{c}", c) for c in chunk_counts]
     if include_ring:
+        # Both ring schedules: the plain ring and the double-buffered
+        # RING_OVERLAP issue order (bit-identical output; on a backend
+        # with async collective lowering the reorder is the overlap win
+        # this race exists to measure, on the synchronous CPU mesh the
+        # two honestly tie).
         variants.append(("ring", None))
+        variants.append(("ring-overlap", None))
     fns, hlo = {}, {}
     for name, chunks in variants:
         snd = (pm.SendMethod.RING if name == "ring"
+               else pm.SendMethod.RING_OVERLAP if name == "ring-overlap"
                else pm.SendMethod.SYNC if chunks is None
                else pm.SendMethod.STREAMS)
         cfg = pm.Config(comm_method=pm.CommMethod.parse(comm),

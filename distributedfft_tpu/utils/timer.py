@@ -33,11 +33,12 @@ from ..params import CommMethod, Config, GlobalSize, SendMethod
 from . import native_planner
 
 _COMM_CODE = {CommMethod.PEER2PEER: 0, CommMethod.ALL2ALL: 1}
-# 0-2 are the reference's own send codes (params.hpp:87-89); 3 extends the
-# filename schema for the RING rendering, which has no reference analog —
-# eval reduction keys on the literal code, so new codes only add rows.
+# 0-2 are the reference's own send codes (params.hpp:87-89); 3 and 4
+# extend the filename schema for the RING / RING_OVERLAP renderings, which
+# have no reference analog — eval reduction keys on the literal code, so
+# new codes only add rows.
 _SEND_CODE = {SendMethod.SYNC: 0, SendMethod.STREAMS: 1, SendMethod.MPI_TYPE: 2,
-              SendMethod.RING: 3}
+              SendMethod.RING: 3, SendMethod.RING_OVERLAP: 4}
 # Wire-dtype filename codes (mirroring the send-code-3 extension pattern):
 # the reference schema has no wire slot, so the NATIVE wire keeps the
 # legacy filename byte-for-byte (pre-wire CSVs stay comparable) and a

@@ -21,7 +21,7 @@ zero measurement time.
 
 Store format: ONE JSON file::
 
-    {"version": 3,
+    {"version": 4,
      "entries": {"<canonical key json>": {"local_fft": {...}, "comm": {...},
                                           "wire": {...}}}}
 
@@ -34,12 +34,14 @@ Version 2 added the RING (ppermute-ring) rendering to the comm race.
 Version 3 added the WIRE axis: ``comm`` records gained ``wire_dtype``
 (the comm race crosses every cell with the bf16 compressed-wire twin,
 error-budget-gated), and the ``wire`` slot records the wire-only race run
-for ``Config(wire_dtype="auto")`` with an explicit comm method. Legacy
-stores MIGRATE rather than error: ``local_fft`` (and any other
-non-``comm``) records are wire-agnostic and carry over verbatim, while
-v1/v2 ``comm`` records were winners of races that never saw the ring
-(v1) or wire (v1/v2) axis and therefore read as misses (re-raced once,
-re-recorded under v3). Any later/unknown version reads as empty.
+for ``Config(wire_dtype="auto")`` with an explicit comm method.
+Version 4 added the RING_OVERLAP (double-buffered ring) rendering to the
+comm race (ISSUE 10). Legacy stores MIGRATE rather than error:
+``local_fft``/``wire`` (and any other non-``comm``) records are agnostic
+to the comm-race axes and carry over verbatim, while older ``comm``
+records were winners of races that never saw the ring (v1), wire (v1/v2)
+or overlap (v1-v3) axis and therefore read as misses (re-raced once,
+re-recorded under v4). Any later/unknown version reads as empty.
 
 Degradation contract: a missing, corrupt, partially-valid or
 version-mismatched store reads as EMPTY (re-measure); a record whose fields
@@ -111,10 +113,10 @@ except ImportError:
         def lock_contended() -> bool:
             return False
 
-WISDOM_VERSION = 3
+WISDOM_VERSION = 4
 # Store versions that migrate on load instead of reading empty (their
 # non-"comm" slots carry over; see _migrate_legacy).
-_LEGACY_VERSIONS = (1, 2)
+_LEGACY_VERSIONS = (1, 2, 3)
 ENV_VAR = "DFFT_WISDOM"
 # Wire dtypes a stored record may carry (the "auto" marker never lands on
 # disk — records hold measured winners).
@@ -312,11 +314,12 @@ class WisdomStore:
 
     @staticmethod
     def _migrate_legacy(raw: Dict[str, Any]) -> Dict[str, Any]:
-        """Version-1/2 store -> version-3 view: ``local_fft`` (and any
-        other non-``comm``) records are wire-agnostic and carry over;
-        ``comm`` records predate an axis of the race (the RING rendering
-        for v1, the wire dtype for v1 and v2) and are dropped, so they
-        re-measure as ordinary misses. Persisted as v3 by the next
+        """Legacy (v1-v3) store -> version-4 view: ``local_fft``/``wire``
+        (and any other non-``comm``) records are agnostic to the
+        comm-race axes and carry over; ``comm`` records predate an axis
+        of the race (the RING rendering for v1, the wire dtype for v1/v2,
+        the RING_OVERLAP rendering for v1-v3) and are dropped, so they
+        re-measure as ordinary misses. Persisted as v4 by the next
         ``record``."""
         entries = {}
         for k, e in raw["entries"].items():
@@ -329,9 +332,9 @@ class WisdomStore:
 
     def load(self) -> Dict[str, Any]:
         """Parsed store; ANY defect (missing file, malformed JSON, wrong
-        schema, unknown version) degrades to the empty store. A version-1
-        or -2 store migrates (see ``_migrate_legacy``) instead of reading
-        empty."""
+        schema, unknown version) degrades to the empty store. A legacy
+        (v1-v3) store migrates (see ``_migrate_legacy``) instead of
+        reading empty."""
         with obs.span("wisdom.load", path=self.path):
             try:
                 with open(self.path, "r", encoding="utf-8") as f:
@@ -807,7 +810,9 @@ def _describe_comm(cfg: Any) -> str:
     if cfg.comm_method2 is not None:
         tag += f"+{cfg.comm_method2.value}"
     tag += f"/opt{cfg.opt}"
-    if cfg.send_method is pm.SendMethod.RING:
+    if cfg.send_method is pm.SendMethod.RING_OVERLAP:
+        tag += "/ring-ovl"
+    elif cfg.send_method is pm.SendMethod.RING:
         tag += "/ring"
     elif cfg.send_method is pm.SendMethod.STREAMS:
         tag += f"/streams{cfg.resolved_streams_chunks()}"

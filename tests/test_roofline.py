@@ -21,11 +21,16 @@ def test_axis_mac_counts_direct():
 
 
 def test_axis_mac_counts_fourstep_and_radix2():
-    # 2048 > DIRECT_MAX=512 -> _split(2048) = (32, 64): four-step sums the
-    # two factor contractions.
-    assert rl.macs_c2c_axis(2048) == 4 * 64 + 4 * 32
+    # 2048 > DIRECT_MAX=512 -> the MXU-deep dispatch (_split_for) factors
+    # it 4x512 (dominant factor at full direct depth — the ISSUE 10
+    # large-axis extension), not the balanced 32x64: the model mirrors
+    # ops/mxu_fft.py's actual four-step choice.
+    from distributedfft_tpu.ops.mxu_fft import _split_for
+    assert _split_for(2048, 512) == (4, 512)
+    assert _split_for(4096, 512) == (8, 512)
+    assert rl.macs_c2c_axis(2048) == 4 * 512 + 4 * 4
     # R2C four-step: real pair on n2 + complex on n1 (full volume).
-    assert rl.macs_r2c_axis(2048) == 2 * 64 + 4 * 32
+    assert rl.macs_r2c_axis(2048) == 2 * 512 + 4 * 4
     # C2R beyond direct: hermitian-extend + full complex inverse.
     assert rl.macs_c2r_axis(2048) == rl.macs_c2c_axis(2048)
     # Radix-2 DIF halves depth down to the 128 base case.
@@ -94,11 +99,14 @@ def test_parse_backend_plan_suffixes():
 
 
 def test_fourstep_suffix_macs_match_measured_plan():
-    """four-step(16x32) -> direct_max=32 must reproduce the MACs of the
-    session's actual plan (direct_max=256): _split(512) = (16, 32) and
-    both factors run direct under either threshold."""
-    assert rl.mxu_flops_roundtrip_3d(512, 32) == rl.mxu_flops_roundtrip_3d(
-        512, 256)
+    """A four-step(AxB) suffix -> direct_max=max(A,B) must reproduce the
+    exact plan the row was measured under: B divides n and is the largest
+    divisor <= B, so _split_for(n, B) == (A, B) for every annotated row —
+    the mapping is exact under the MXU-deep dispatch too."""
+    from distributedfft_tpu.ops.mxu_fft import _split_for
+    assert _split_for(512, 32) == (16, 32)     # four-step(16x32)
+    assert _split_for(2048, 64) == (32, 64)    # four-step(32x64), old CSV
+    assert _split_for(4096, 64) == (64, 64)    # four-step(64x64), old CSV
 
 
 def test_metric_size_rows_in_roofline():
@@ -107,3 +115,75 @@ def test_metric_size_rows_in_roofline():
     rows = rl.roofline_rows(CSV)
     assert any(r["size"] == "1024^3" for r in rows)
     assert any(r["size"] == "4096^2x64" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# roofline_fraction (ISSUE 10: the tracked per-row gate)
+# ---------------------------------------------------------------------------
+
+def test_ideal_time_and_fraction_cube():
+    """fraction = ideal/measured with ideal from the exact MXU model: a
+    measurement AT the model's time scores 1.0, half speed scores 0.5."""
+    ideal = rl.ideal_time_ms("256^3", "matmul@high")
+    assert ideal is not None and ideal > 0
+    assert rl.roofline_fraction(ideal, "256^3", "matmul") == 1.0
+    assert abs(rl.roofline_fraction(2 * ideal, 256, "matmul") - 0.5) < 1e-3
+
+
+def test_fraction_shape_forms_agree():
+    """Every accepted size spelling — '256^3', '256', int, (n,n,n) tuple —
+    resolves to the same model."""
+    vals = {rl.ideal_time_ms(f, "matmul")
+            for f in ("256^3", "256", 256, (256, 256, 256))}
+    assert len(vals) == 1
+
+
+def test_fraction_modes_and_devices():
+    """One-way modes halve the flops; a mesh divides the per-chip share
+    (communication deliberately NOT modeled — it shows up as lost
+    fraction)."""
+    rt = rl.ideal_time_ms(256, "matmul")
+    assert abs(rl.ideal_time_ms(256, "matmul", mode="forward") - rt / 2) \
+        < 1e-9
+    assert abs(rl.ideal_time_ms(256, "matmul", devices=8) - rt / 8) < 1e-9
+
+
+def test_fraction_nominal_model_for_non_matmul():
+    """xla/pallas/bluestein rows take the nominal 2.5·N·log2 N model (no
+    honest MXU count) and say so in the record."""
+    row = rl.roofline_row(10.0, "256^3", "xla")
+    assert row["model"].startswith("nominal")
+    assert row["roofline_fraction"] > 0
+
+
+def test_fraction_direct_plan_override():
+    """The direct(N) bench plan note must reach the model: the all-direct
+    1024 plan issues more MACs than the four-step default."""
+    d = rl.ideal_time_ms(1024, "matmul", direct_max=1024)
+    f = rl.ideal_time_ms(1024, "matmul")
+    assert d > f
+
+
+def test_fraction_unmodelable_returns_none():
+    assert rl.roofline_fraction(1.0, "20x16x7", "matmul") is None
+    assert rl.roofline_fraction(0.0, "256^3", "matmul") is None
+    assert rl.roofline_row(-1.0, "256^3", "matmul") is None
+    assert rl._parse_size((20, 16, 7)) is None
+
+
+def test_fraction_inverse_row_key():
+    """Bench row keys like '256:inverse' parse (mode tag ignored by the
+    size parser; bench passes the mode explicitly)."""
+    assert rl._parse_size("256:inverse") == ("cube", 256)
+    assert rl._parse_size("4096^2x64") == ("b2d", (64, 4096))
+
+
+def test_committed_bench_details_roofline_block():
+    """The committed BENCH_DETAILS.json must carry the tracked roofline
+    block with a fraction per row (ISSUE 10 acceptance; the CI roofline
+    job regresses against exactly these rows)."""
+    rows = rl.tracked_fractions()
+    assert rows, "BENCH_DETAILS.json has no roofline.rows block"
+    for key, rec in rows.items():
+        assert "roofline_fraction" in rec and rec["roofline_fraction"] > 0, key
+        assert "ideal_ms" in rec and "model" in rec, key

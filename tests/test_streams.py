@@ -187,16 +187,19 @@ def test_overlap_race_contract(devices):
 
     r = overlap_race((16, 16, 16), 8, chunk_counts=(2,), k=3, repeats=2,
                      iterations=2, warmup=1)
-    assert set(r["variants"]) == {"sync", "streams2", "ring"}
+    assert set(r["variants"]) == {"sync", "streams2", "ring",
+                                  "ring-overlap"}
     assert r["variants"]["sync"]["hlo"]["all_to_all"] == 2  # fwd + inv
     assert r["variants"]["streams2"]["hlo"]["all_to_all"] == 4
-    ring_hlo = r["variants"]["ring"]["hlo"]
-    assert ring_hlo["all_to_all"] == 0
-    # Sum plain + async-start forms: TPU lowering rewrites each permute
-    # into a collective-permute-start/done pair, so the plain form alone
-    # would read 0 there (the test_ring HLO gates count the same way).
-    assert ring_hlo["collective_permute"] + \
-        ring_hlo["collective_permute_start"] >= 14  # (P-1) x (fwd + inv)
+    for ring_name in ("ring", "ring-overlap"):
+        ring_hlo = r["variants"][ring_name]["hlo"]
+        assert ring_hlo["all_to_all"] == 0
+        # Sum plain + async-start forms: TPU lowering rewrites each
+        # permute into a collective-permute-start/done pair, so the plain
+        # form alone would read 0 there (the test_ring HLO gates count
+        # the same way).
+        assert ring_hlo["collective_permute"] + \
+            ring_hlo["collective_permute_start"] >= 14  # (P-1)x(fwd+inv)
     for v in r["variants"].values():
         assert "per_iter_ms" in v or v.get("degenerate")
 

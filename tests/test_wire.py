@@ -16,8 +16,9 @@ halves a complex64 exchange's wire bytes. These tests pin
   2 wire crossings, pencil ~1e-2 at 4);
 * (c) ``jit(grad)`` traces through a compressed plan (convert/ppermute
   differentiate);
-* (d) wisdom schema v3: v2 (and v1) stores migrate — ``local_fft``
-  carries over, ``comm`` re-races — and records round-trip as v3;
+* (d) wisdom schema migration: legacy (v1-v3) stores migrate —
+  ``local_fft`` carries over, ``comm`` re-races — and records round-trip
+  at the current version (v4 since the RING_OVERLAP race axis);
 * (e) the autotune wire axis: ``race_wire`` twins are error-gated and the
   winner folds; ``wire_dtype="auto"`` resolves through the store;
 * (f) the microbench satellite: ``async_collective_counts`` counts the
@@ -273,7 +274,7 @@ def test_grad_through_bf16_ring_roundtrip(devices, rng):
 
 
 # ---------------------------------------------------------------------------
-# (d) wisdom schema v3: v2 (and v1) migration round-trip
+# (d) wisdom schema migration round-trip (current version: 4)
 # ---------------------------------------------------------------------------
 
 def _legacy_store(tmp_path, version: int):
@@ -290,14 +291,15 @@ def _legacy_store(tmp_path, version: int):
     return wisdom.WisdomStore(str(path)), key
 
 
-@pytest.mark.parametrize("version", [1, 2])
-def test_legacy_store_migrates_to_v3(tmp_path, version):
-    """v1/v2 stores load as a migrated v3 view: local_fft records carry
-    over verbatim, comm records (raced without the wire axis) read as
-    misses; the next record persists version 3 on disk."""
+@pytest.mark.parametrize("version", [1, 2, 3])
+def test_legacy_store_migrates_to_current(tmp_path, version):
+    """Legacy (v1-v3) stores load as a migrated current-version view:
+    local_fft records carry over verbatim, comm records (raced without
+    the wire axis for v1/v2, without the RING_OVERLAP axis for v3) read
+    as misses; the next record persists the current version on disk."""
     store, key = _legacy_store(tmp_path, version)
     data = store.load()
-    assert data["version"] == wisdom.WISDOM_VERSION == 3
+    assert data["version"] == wisdom.WISDOM_VERSION == 4
     assert "comm" not in data["entries"][key]
     assert data["entries"][key]["local_fft"]["fft_backend"] == "xla"
     assert store.lookup(key, "comm") is None
@@ -306,7 +308,7 @@ def test_legacy_store_migrates_to_v3(tmp_path, version):
            "wire_dtype": "bf16", "wire_raced": True}
     assert store.record(key, "comm", rec)
     raw = json.loads(open(store.path).read())
-    assert raw["version"] == 3
+    assert raw["version"] == 4
     assert raw["entries"][key]["comm"]["wire_dtype"] == "bf16"
     assert raw["entries"][key]["local_fft"]["fft_backend"] == "xla"
     # Round-trip: the persisted v3 record folds back with its wire axis.
@@ -391,7 +393,7 @@ def test_wire_auto_resolves_and_records(devices, tmp_path):
     assert plan.config.comm_method is pm.CommMethod.ALL2ALL
     assert plan.config.opt == 1
     raw = json.loads(open(path).read())
-    assert raw["version"] == 3
+    assert raw["version"] == 4
     (entry,) = [e for e in raw["entries"].values() if "wire" in e]
     assert entry["wire"]["wire_dtype"] == plan.config.wire_dtype
     # Hit path: poison the recorded winner to prove the store answers. A

@@ -13,26 +13,56 @@ live in one registry instead of N drifting test asserts:
 * ``contracts``  — the declarative contract model + registry: expected
   collective census per rendering, forbidden-op rules, predicted-vs-
   actual exchange payload bytes reconciled with ``wire_nbytes``;
+* ``plangraph``  — the declared stage-graph IR: every family emits a
+  typed graph (local-FFT / exchange / wire encode/decode / guard /
+  fused-kernel nodes; edges carry shape/dtype/sharding/wire bytes) with
+  well-formedness, graph<->contract and graph<->trace conformance
+  checks — the machine-checked pipeline the Plan-IR refactor lowers
+  from;
+* ``schedverify`` — the static hazard checker over the revolving-buffer
+  ring schedules (read-before-arrive / write-after-send / overflow /
+  lost-block), proving the RING_OVERLAP pipeline safe at any buffer
+  depth before it traces;
 * ``jaxprlint``  — jaxpr dataflow lints (unpaired wire encode/decode,
   dtype drift across an exchange, guard ops present at ``guards="off"``);
 * ``srclint``    — AST-level repo-invariant lints (no host I/O in traced
-  fns, host-only modules stay jax.numpy-free, wisdom-store writes only
-  under the flock helper);
+  fns, host-only modules stay jax.numpy-free, atomic store writes only
+  under the flock helper — ``serve/`` and ``solvers/`` included);
 * ``verify``     — the ``dfft-verify`` runner: the full combo matrix as
-  a pass/fail table, mutation self-tests, JSON artifact for CI.
+  a pass/fail table (the plan-graph pass on every combo), mutation
+  self-tests, the schedule sweep, JSON artifact for CI.
 
 These are the "HLO byte-identity pins as the migration safety net" the
 Plan-IR refactor (ROADMAP item 1) gates on: a rendering PR is done when
 ``dfft-verify`` passes clean.
 """
 
-from . import contracts, hloscan, jaxprlint, srclint  # noqa: F401
+from . import (  # noqa: F401
+    contracts,
+    hloscan,
+    jaxprlint,
+    plangraph,
+    schedverify,
+    srclint,
+)
 from .contracts import (  # noqa: F401
     Contract,
     ContractViolation,
     check_contract,
     contract_for,
     verify_plan,
+)
+from .plangraph import (  # noqa: F401
+    PlanGraph,
+    StageEdge,
+    StageNode,
+    check_graph,
+    graph_for,
+    verify_graph,
+)
+from .schedverify import (  # noqa: F401
+    check_schedule,
+    revolving_schedule,
 )
 from .hloscan import (  # noqa: F401
     collective_census,

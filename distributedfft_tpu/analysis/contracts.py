@@ -200,9 +200,20 @@ def contract_for(plan: Any, direction: str = "forward",
     family = family_of(plan)
     decls = tuple(_FAMILIES[family](plan, direction, dims))
     cfg = plan.config
-    wire = cfg.wire_dtype
-    guards = getattr(plan, "_guard_mode", "off")
-    cdt = _complex_dtype(plan)
+    return contract_from_decls(family, direction, cfg.wire_dtype,
+                               getattr(plan, "_guard_mode", "off"),
+                               _complex_dtype(plan), decls)
+
+
+def contract_from_decls(family: str, direction: str, wire: str,
+                        guards: str, complex_dtype: Any,
+                        decls: Tuple[ExchangeDecl, ...]) -> Contract:
+    """The rendering algebra over an explicit declaration set — the
+    resolution core of ``contract_for``, factored out so a contract can
+    be synthesized from ANY declaration source (``plangraph`` derives
+    one from a declared stage graph, proving the graph's exchanges
+    against the same compiled census the family contract pins)."""
+    cdt = complex_dtype
 
     n_a2a = 0          # deterministic all-to-all instances
     ring_steps = 0     # minimum collective-permute instances

@@ -194,11 +194,15 @@ def plan_jaxpr(plan: Any, direction: str = "forward", dims: int = 3) -> Any:
 
 
 def lint_plan(plan: Any, direction: str = "forward",
-              dims: int = 3) -> List[LintFinding]:
-    """All jaxpr lints over one direction of a live plan."""
+              dims: int = 3,
+              jaxpr: Optional[Any] = None) -> List[LintFinding]:
+    """All jaxpr lints over one direction of a live plan. ``jaxpr``
+    lets a caller that already traced the combo (``dfft-verify`` shares
+    one trace with the plan-graph pass) skip re-tracing."""
     from . import contracts
 
-    jaxpr = plan_jaxpr(plan, direction, dims)
+    if jaxpr is None:
+        jaxpr = plan_jaxpr(plan, direction, dims)
     wire = plan.config.wire_dtype
     crossings = 0
     if wire != "native":

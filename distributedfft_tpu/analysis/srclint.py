@@ -17,11 +17,20 @@ Three rules, each a real invariant this codebase already relies on:
   standalone by the flock-contract subprocess tests and tracing must
   stay importable before any backend exists; a ``jnp`` import would
   initialize a backend as a side effect of reading a JSON file.
-* ``wisdom-flock`` — every wisdom-store write (the atomic
-  ``os.replace`` onto the store path) must be reachable only under the
+* ``wisdom-flock`` — every ``os.replace`` (the atomic-write idiom) in
+  a lock-disciplined module must be reachable only under the
   ``_advisory_lock`` flock helper: a write outside the lock re-opens
   the read-merge-replace race the helper exists to close. This is a
-  static race detector for the store.
+  static race detector; it covers the wisdom store
+  (``utils/wisdom.py``, the rule's namesake) AND the post-PR-6
+  packages that persist state from long-lived processes — ``serve/``
+  (plan-cache / health snapshots) and ``solvers/`` (checkpoint state,
+  ROADMAP item 5c) — which shipped after the lint and were previously
+  outside its scope.
+
+The ``traced-host-io`` rule scans EVERY module ``lint_repo`` walks
+(``scanned_files`` is the canonical list — ``serve/`` and ``solvers/``
+included; the completeness test pins them in the walk).
 
 An inline ``# srclint: allow(<rule>)`` comment on the offending line
 suppresses a finding — visible, greppable, reviewed.
@@ -269,6 +278,35 @@ def _lint_host_only_jnp(path: str, tree: ast.Module,
 
 LOCK_HELPER = "_advisory_lock"
 
+# Modules whose os.replace writes must stay under the flock helper: the
+# wisdom store (the rule's origin), plus every module of the serve/ and
+# solvers/ packages — long-lived processes persisting shared state
+# (plan-cache spills, health snapshots, solver checkpoints) re-open the
+# exact read-merge-replace race the helper closes.
+LOCKED_REPLACE_MODULES = (os.path.join("utils", "wisdom.py"),)
+LOCKED_REPLACE_PACKAGES = ("serve", "solvers")
+
+
+def _replace_lock_applies(path: str) -> bool:
+    if any(path.endswith(m) for m in LOCKED_REPLACE_MODULES):
+        return True
+    # Match package names against components INSIDE the package tree
+    # only — a checkout path that happens to contain a directory named
+    # "serve" must not widen the rule to the whole repo. Paths under
+    # package_root() are matched relative to it; relative paths (the
+    # synthetic-source form the tests use) are matched as given; other
+    # absolute paths are out of scope.
+    root = package_root()
+    abspath = os.path.abspath(path)
+    if abspath.startswith(root + os.sep):
+        rel = os.path.relpath(abspath, root)
+    elif not os.path.isabs(path):
+        rel = path
+    else:
+        return False
+    parts = rel.replace("\\", "/").split("/")
+    return any(pkg in parts[:-1] for pkg in LOCKED_REPLACE_PACKAGES)
+
 
 def _locked_withs(tree: ast.Module) -> List[ast.With]:
     out = []
@@ -284,10 +322,11 @@ def _locked_withs(tree: ast.Module) -> List[ast.With]:
 
 def _lint_wisdom_flock(path: str, tree: ast.Module,
                        src_lines: List[str]) -> List[SrcFinding]:
-    """Every ``os.replace`` (the store's atomic write) must sit inside a
-    ``with _advisory_lock(...)`` block — lexically, or in a function
-    whose every same-module call site does."""
-    if not path.endswith(os.path.join("utils", "wisdom.py")):
+    """Every ``os.replace`` (the atomic-write idiom) in a
+    lock-disciplined module (wisdom store, serve/, solvers/) must sit
+    inside a ``with _advisory_lock(...)`` block — lexically, or in a
+    function whose every same-module call site does."""
+    if not _replace_lock_applies(path):
         return []
     locked = _locked_withs(tree)
     locked_nodes: Set[ast.AST] = set()
@@ -332,7 +371,7 @@ def _lint_wisdom_flock(path: str, tree: ast.Module,
             continue
         out.append(SrcFinding(
             "wisdom-flock", path, call.lineno,
-            "wisdom-store write (os.replace) reachable outside the "
+            "atomic store write (os.replace) reachable outside the "
             f"{LOCK_HELPER} flock helper — re-opens the "
             "read-merge-replace race"))
     return out
@@ -362,23 +401,35 @@ def package_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def lint_repo(root: Optional[str] = None,
-              skip: Iterable[str] = ()) -> List[SrcFinding]:
-    """Lint every module under ``distributedfft_tpu/`` (or ``root``)."""
+def scanned_files(root: Optional[str] = None,
+                  skip: Iterable[str] = ()) -> List[str]:
+    """Every module ``lint_repo`` walks — the canonical scope of the
+    repo lints (``serve/`` and ``solvers/`` included; the completeness
+    test pins that, so a new package cannot silently fall outside the
+    lint gate)."""
     root = root or package_root()
-    out: List[SrcFinding] = []
+    skip = set(skip)
+    out: List[str] = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in sorted(filenames):
             if not name.endswith(".py"):
                 continue
             path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            if rel in skip:
+            if os.path.relpath(path, root) in skip:
                 continue
-            try:
-                out.extend(lint_file(path))
-            except SyntaxError as e:
-                out.append(SrcFinding("parse", path, e.lineno or 0,
-                                      f"syntax error: {e.msg}"))
+            out.append(path)
+    return out
+
+
+def lint_repo(root: Optional[str] = None,
+              skip: Iterable[str] = ()) -> List[SrcFinding]:
+    """Lint every module under ``distributedfft_tpu/`` (or ``root``)."""
+    out: List[SrcFinding] = []
+    for path in scanned_files(root, skip):
+        try:
+            out.extend(lint_file(path))
+        except SyntaxError as e:
+            out.append(SrcFinding("parse", path, e.lineno or 0,
+                                  f"syntax error: {e.msg}"))
     return out

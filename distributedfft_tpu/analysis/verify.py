@@ -4,7 +4,18 @@ Lowers and COMPILES (never executes) every rendering x direction x wire
 x guard combo of the three plan families and checks each against its
 declarative contract (``analysis/contracts.py``), plus:
 
+* the PLAN-GRAPH pass per combo (``analysis/plangraph.py``): every
+  family must declare a typed stage graph for every combo (a missing
+  declaration is a combo FAILURE, never a skip), the graph must be
+  well-formed (dataflow soundness, encode/decode pairing, dtype flow,
+  payload conservation with the ring discount, guard arity, hazard-free
+  ring schedules), must reconcile with the family's exchange contract,
+  and must conform to the traced/compiled program;
 * jaxpr dataflow lints per combo (``analysis/jaxprlint.py``);
+* the schedule hazard sweep (``analysis/schedverify.py``): the
+  generalized revolving-buffer RING_OVERLAP schedule must check clean
+  at depths 2/4/8 for this mesh (plus the serial ring and the
+  single-peer degenerate);
 * zero-overhead-off fingerprint pins: obs enabled/disabled, fault spec
   set-then-unset, and ``guards="enforce"`` vs ``"check"`` compile to
   byte-identical (metadata-stripped) op graphs;
@@ -20,6 +31,11 @@ Mutation self-test (the verifier verifying itself)::
     dfft-verify --mutate all             # all mutations, rc 0 iff every
                                          # one is CAUGHT with the right
                                          # diagnostic
+
+Graph-defect mutations: ``drop-decode-node`` (a declared graph whose
+decode stage was deleted), ``phantom-exchange`` (a graph declaring an
+exchange the build never stages), ``hazard-schedule`` (a revolving
+schedule with a write-after-send hazard).
 
 Examples::
 
@@ -37,7 +53,8 @@ import sys
 import tempfile
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
-MUTATIONS = ("drop-decode", "bogus-census", "flip-forbidden")
+MUTATIONS = ("drop-decode", "bogus-census", "flip-forbidden",
+             "drop-decode-node", "phantom-exchange", "hazard-schedule")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -184,7 +201,7 @@ def run_combo(combo: Dict[str, Any], ndev: int,
     import distributedfft_tpu as dfft
     from distributedfft_tpu import params as pm
 
-    from . import contracts, hloscan, jaxprlint
+    from . import contracts, hloscan, jaxprlint, plangraph
 
     if combo.get("bluestein"):
         # Prime (non-smooth) z axis: 19 -> halved 10; x stays the uneven
@@ -216,11 +233,36 @@ def run_combo(combo: Dict[str, Any], ndev: int,
         staged = hloscan.staged_exchange_total(plan, direction, dims)
     violations = [str(v) for v in
                   contracts.check_contract(contract, census, txt, staged)]
+    # The plan-graph pass: resolve the declared stage graph (a missing
+    # declaration is a FAILURE — the completeness half of the pass),
+    # check well-formedness + contract conformance, and reconcile it
+    # against the traced jaxpr and the already-compiled module (shared —
+    # one compile per combo stays true).
+    graph_summary = None
+    jaxpr = jaxprlint.plan_jaxpr(plan, direction, dims)
+    try:
+        graph = plangraph.graph_for(plan, direction, dims)
+    except plangraph.MissingGraph as e:
+        violations.append(f"[plangraph] no stage graph declared for "
+                          f"this combo: {e}")
+    else:
+        graph_summary = dict(name=graph.name, nodes=len(graph.nodes),
+                             edges=len(graph.edges),
+                             exchanges=len(graph.exchanges()))
+        violations += [str(v) for v in plangraph.check_graph(graph)]
+        violations += [str(v) for v in
+                       plangraph.check_graph_contract(graph, contract)]
+        violations += [str(v) for v in plangraph.check_graph_trace(
+            plan, graph, direction, dims, census=census,
+            compiled_txt=txt, staged=staged, _staged_resolved=True,
+            jaxpr=jaxpr)]
     if not no_jaxprlint:
         violations += [str(f) for f in
-                       jaxprlint.lint_plan(plan, direction, dims)]
+                       jaxprlint.lint_plan(plan, direction, dims,
+                                           jaxpr=jaxpr)]
     return dict(combo, contract=contract.name,
                 census={k: v for k, v in census.items() if v},
+                graph=graph_summary,
                 violations=violations, ok=not violations)
 
 
@@ -353,6 +395,8 @@ def run_mutation(name: str, ndev: int) -> Dict[str, Any]:
             tr.wire_decode = real_decode
         return dict(mutation=name, violations=violations,
                     expect="unpaired wire_encode/wire_decode")
+    if name in ("drop-decode-node", "phantom-exchange", "hazard-schedule"):
+        return _run_graph_mutation(name, ndev)
     plan, dims = _make_plan("slab", "opt1", "native", "off", "ZY_Then_X",
                             ndev)
     contract = contracts.contract_for(plan, "forward", dims)
@@ -377,6 +421,75 @@ def run_mutation(name: str, ndev: int) -> Dict[str, Any]:
                   contracts.verify_plan(plan, "forward", dims,
                                         contract=mutated)]
     return dict(mutation=name, violations=violations, expect=expect)
+
+
+def _run_graph_mutation(name: str, ndev: int) -> Dict[str, Any]:
+    """The plan-graph defect mutations: break a DECLARED graph (or a
+    schedule) on purpose and prove the graph pass catches it."""
+    import dataclasses
+
+    import distributedfft_tpu as dfft
+    from distributedfft_tpu import params as pm
+
+    from . import plangraph, schedverify
+
+    if name == "hazard-schedule":
+        # A revolving schedule that funnels every issue into buffer 0
+        # while claiming depth 2: the second issue overwrites a live
+        # block — the checker must name the hazard class.
+        bad = schedverify.mutated_schedule("write-after-send",
+                                           p=max(3, ndev), depth=2)
+        hazards = schedverify.check_schedule(bad, max(3, ndev), 2)
+        return dict(mutation=name,
+                    violations=[str(h) for h in hazards],
+                    expect="write-after-send")
+    if name == "drop-decode-node":
+        # Delete the decode stage from a declared compressed graph,
+        # reconnecting the exchange straight to the next stage: the
+        # well-formedness pass must flag the unpaired encode.
+        plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
+                                pm.SlabPartition(ndev),
+                                dfft.Config(wire_dtype="bf16",
+                                            use_wisdom=False))
+        g = plangraph.graph_for(plan, "forward")
+        dec = next((n for n in g.nodes if n.decodes()), None)
+        if dec is None:
+            # Single-device degenerate: no exchange, nothing to drop —
+            # report NOT CAUGHT (like the other mutations at ndev=1)
+            # instead of crashing.
+            return dict(mutation=name, violations=[],
+                        expect="unpaired encode/decode")
+        (in_e,) = g.in_edges(dec.id)
+        (out_e,) = g.out_edges(dec.id)
+        nodes = tuple(n for n in g.nodes if n.id != dec.id)
+        edges = tuple(e for e in g.edges if e not in (in_e, out_e)) \
+            + (dataclasses.replace(in_e, dst=out_e.dst),)
+        bad_graph = dataclasses.replace(g, nodes=nodes, edges=edges)
+        return dict(mutation=name,
+                    violations=[str(v) for v in
+                                plangraph.check_graph(bad_graph)],
+                    expect="unpaired encode/decode")
+    # phantom-exchange: declare a second all-to-all exchange the build
+    # function never stages; trace conformance must refuse it.
+    plan, dims = _make_plan("slab", "opt1", "native", "off", "ZY_Then_X",
+                            ndev)
+    g = plangraph.graph_for(plan, "forward", dims)
+    x = next((n for n in g.nodes if n.kind == "exchange"), None)
+    if x is None:
+        return dict(mutation=name, violations=[],
+                    expect="phantom exchange")
+    phantom = dataclasses.replace(x, id="exchange:phantom",
+                                  label="phantom")
+    (out_e,) = g.out_edges(x.id)
+    edges = tuple(e for e in g.edges if e is not out_e) + (
+        dataclasses.replace(out_e, dst="exchange:phantom"),
+        dataclasses.replace(out_e, src="exchange:phantom"))
+    bad_graph = dataclasses.replace(g, nodes=g.nodes + (phantom,),
+                                    edges=edges)
+    violations = [str(v) for v in plangraph.check_graph_trace(
+        plan, bad_graph, "forward", dims)]
+    return dict(mutation=name, violations=violations,
+                expect="phantom exchange")
 
 
 # ---------------------------------------------------------------------------
@@ -423,7 +536,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     report: Dict[str, Any] = {
         "devices": ndev, "platform": platform,
-        "combos": [], "pins": [], "srclint": [],
+        "combos": [], "pins": [], "sched": [], "srclint": [],
     }
     failures = 0
     print(f"dfft-verify: {ndev} device(s) on {platform}")
@@ -454,6 +567,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 failures += 1
             print(f"pin  {pin['pin']:<38} {status}  ({pin['detail']})")
 
+    # Schedule hazard sweep (analysis/schedverify.py): the generalized
+    # revolving-buffer RING_OVERLAP schedule must prove clean at every
+    # autotune-candidate depth for this mesh size, plus the serial ring
+    # and the single-peer degenerate — the static precondition for
+    # ROADMAP item 3's 2/4/8-way buffer-depth autotune.
+    from . import schedverify
+    for sched in schedverify.verify_shipped_depths(ndev):
+        report["sched"].append(sched)
+        status = "PASS" if sched["ok"] else "FAIL"
+        if not sched["ok"]:
+            failures += 1
+        eff = sched.get("effective_depth", sched["depth"])
+        cap = f" (effective {eff})" if eff != sched["depth"] else ""
+        print(f"sched ring p={sched['p']:<3} depth={sched['depth']:<3}"
+              f"{cap} ({sched['timeline_ops']} op(s)) {status}")
+        for h in sched["hazards"]:
+            print(f"    {h}")
+
     if not args.no_srclint:
         from . import srclint
         findings = srclint.lint_repo()
@@ -467,9 +598,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     n = len(report["combos"])
     npins = len(report["pins"])
+    nsched = len(report["sched"])
     verdict = "PASS" if failures == 0 else f"FAIL ({failures} failure(s))"
-    print(f"dfft-verify: {n} combo(s), {npins} pin(s), "
-          f"srclint {'skipped' if args.no_srclint else 'ran'} -> {verdict}")
+    print(f"dfft-verify: {n} combo(s), {npins} pin(s), {nsched} "
+          f"schedule(s), srclint "
+          f"{'skipped' if args.no_srclint else 'ran'} -> {verdict}")
     report["failures"] = failures
     report["ok"] = failures == 0
 

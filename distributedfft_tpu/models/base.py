@@ -17,7 +17,7 @@ maps to a mesh-less plan that calls ``jnp.fft.rfftn`` directly.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +29,8 @@ from ..resilience import fallback, guards
 from ..utils import wisdom
 
 
-def notice_axis_smoothness(kind: str, axes_lengths, config) -> None:
+def notice_axis_smoothness(kind: str, axes_lengths: Iterable[int],
+                           config: Config) -> None:
     """Arbitrary-size axis support, the advisory half: every family
     accepts any axis length (padding handles mesh divisibility), but a
     non-5-smooth length silently leaves the fast path of the matmul /
@@ -51,7 +52,8 @@ def notice_axis_smoothness(kind: str, axes_lengths, config) -> None:
             backend=config.fft_backend)
 
 
-def _with_pad(pure, logical_shape, padded_shape):
+def _with_pad(pure: Callable[..., Any], logical_shape: Sequence[int],
+              padded_shape: Sequence[int]) -> Callable[..., Any]:
     """Wrap a pure pipeline so logical-shaped input is zero-padded to the
     mesh-divisible padded shape (the traced analog of the exec_* padding
     preamble; ``jnp.pad``'s vjp slices the cotangent, so the wrapper stays
@@ -64,7 +66,7 @@ def _with_pad(pure, logical_shape, padded_shape):
 
     import jax.numpy as jnp
 
-    def fn(x):
+    def fn(x: Any) -> Any:
         if tuple(x.shape) == logical:
             if logical != padded:
                 x = jnp.pad(x, [(0, p - s) for p, s in zip(padded, logical)])
@@ -169,39 +171,39 @@ class DistFFTPlan:
 
     # -- execution --------------------------------------------------------
 
-    def exec_r2c(self, x):
+    def exec_r2c(self, x: Any) -> Any:
         """Forward real-to-complex transform (reference ``execR2C``),
         inside the resilience envelope (``fallback.execute``): guards
         checked per the plan's mode, pipeline failures walk the
         degradation ladder."""
         return fallback.execute(self, "forward", x, self._get_r2c)
 
-    def exec_c2r(self, x):
+    def exec_c2r(self, x: Any) -> Any:
         """Inverse complex-to-real transform (reference ``execC2R``)."""
         return fallback.execute(self, "inverse", x, self._get_c2r)
 
-    def _get_r2c(self):
+    def _get_r2c(self) -> Any:
         if self._r2c is None:
             self._r2c = self._build_r2c()
         return self._r2c
 
-    def _get_c2r(self):
+    def _get_c2r(self) -> Any:
         if self._c2r is None:
             self._c2r = self._build_c2r()
         return self._c2r
 
-    def _build_r2c(self):
+    def _build_r2c(self) -> Any:
         raise NotImplementedError
 
-    def _build_c2r(self):
+    def _build_c2r(self) -> Any:
         raise NotImplementedError
 
-    def _guard_spec(self, direction: str, dims: int = 3):
+    def _guard_spec(self, direction: str, dims: int = 3) -> Any:
         """The family's ``guards.GuardSpec`` for one direction (only
         consulted at modes check/enforce)."""
         raise NotImplementedError
 
-    def _wisdom_key_args(self) -> dict:
+    def _wisdom_key_args(self) -> Dict[str, Any]:
         """Key components of this plan's wisdom entry (the fallback
         ladder's demotion stamp targets the exact cell that failed)."""
         raise NotImplementedError
@@ -245,7 +247,7 @@ class DistFFTPlan:
         engine overrides per sequence)."""
         return 2
 
-    def exec_fwd(self, x):
+    def exec_fwd(self, x: Any) -> Any:
         """Forward transform through the plan's own transform family
         (r2c -> ``exec_r2c``, c2c -> ``exec_c2c``) — the solver suite's
         uniform entry point."""
@@ -253,7 +255,7 @@ class DistFFTPlan:
             return self.exec_c2c(x)
         return self.exec_r2c(x)
 
-    def exec_inv(self, c):
+    def exec_inv(self, c: Any) -> Any:
         """Inverse transform (see ``exec_fwd``)."""
         if getattr(self, "transform", "r2c") == "c2c":
             return self.exec_c2c_inv(c)
@@ -261,7 +263,7 @@ class DistFFTPlan:
 
     # -- pure pipelines (compose under user transforms) --------------------
 
-    def forward_fn(self):
+    def forward_fn(self) -> Callable[..., Any]:
         """The PURE forward pipeline: the same composition `exec_r2c` jits,
         but with no ``jax.jit`` wrapper and no input/output sharding
         annotations, so it composes under USER transforms — ``jax.grad``
@@ -274,13 +276,13 @@ class DistFFTPlan:
         rule under shard_map). See tests/test_autodiff.py."""
         raise NotImplementedError
 
-    def inverse_fn(self):
+    def inverse_fn(self) -> Callable[..., Any]:
         """Pure inverse pipeline (see ``forward_fn``)."""
         raise NotImplementedError
 
     # -- single-device fallback ------------------------------------------
 
-    def _chunk_for(self, nx: int):
+    def _chunk_for(self, nx: int) -> Optional[int]:
         """Validated ``Config.fft3d_chunk`` for a leading extent of
         ``nx`` (None = fused path)."""
         ck = self.config.fft3d_chunk
@@ -291,12 +293,12 @@ class DistFFTPlan:
                              f"{nx}")
         return ck
 
-    def _fft3d_r2c(self, jit: bool = True):
+    def _fft3d_r2c(self, jit: bool = True) -> Any:
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
         ck = self._chunk_for(self.input_shape[0])
 
-        def run(x):
+        def run(x: Any) -> Any:
             if ck is None:
                 return local_fft.rfftn_3d(x, norm=norm, backend=be,
                                           settings=st)
@@ -306,7 +308,7 @@ class DistFFTPlan:
             # full axis and runs on the already-halved spectrum.
             nx = x.shape[0]
 
-            def per(xs):
+            def per(xs: Any) -> Any:
                 c = local_fft.rfft(xs, axis=-1, norm=norm, backend=be,
                                    settings=st)
                 return local_fft.fft(c, axis=-2, norm=norm, backend=be,
@@ -320,13 +322,13 @@ class DistFFTPlan:
 
         return self._jit_guarded(run, "forward") if jit else run
 
-    def _fft3d_c2r(self, jit: bool = True):
+    def _fft3d_c2r(self, jit: bool = True) -> Any:
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
         shape = self.input_shape
         ck = self._chunk_for(shape[0])
 
-        def run(c):
+        def run(c: Any) -> Any:
             if ck is None:
                 return local_fft.irfftn_3d(c, shape, norm=norm, backend=be,
                                            settings=st)
@@ -334,7 +336,7 @@ class DistFFTPlan:
             c = local_fft.ifft(c, axis=-3, norm=norm, backend=be,
                                settings=st)
 
-            def per(cs):
+            def per(cs: Any) -> Any:
                 y = local_fft.ifft(cs, axis=-2, norm=norm, backend=be,
                                    settings=st)
                 return local_fft.irfft(y, n=nz, axis=-1, norm=norm,
@@ -346,14 +348,14 @@ class DistFFTPlan:
 
         return self._jit_guarded(run, "inverse") if jit else run
 
-    def _fft3d_c2c(self, forward: bool, jit: bool = True):
+    def _fft3d_c2c(self, forward: bool, jit: bool = True) -> Any:
         """Single-device full 3D C2C (both directions unnormalized under
         FFTNorm.NONE, like cuFFT's CUFFT_FORWARD/CUFFT_INVERSE)."""
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
         axes = (-3, -2, -1)
 
-        def run(c):
+        def run(c: Any) -> Any:
             if forward:
                 return local_fft.fftn(c, axes, norm=norm, backend=be, settings=st)
             return local_fft.ifftn(c, axes, norm=norm, backend=be, settings=st)
@@ -362,7 +364,8 @@ class DistFFTPlan:
             return run
         return self._jit_guarded(run, "forward" if forward else "inverse")
 
-    def _jit_guarded(self, run, direction: str):
+    def _jit_guarded(self, run: Callable[..., Any],
+                     direction: str) -> Any:
         """Jit a single-device pipeline with the guard wrapper applied at
         modes check/enforce (``guards.maybe_wrap``; a no-op pass-through —
         same callable, identical HLO — at "off")."""
@@ -371,11 +374,16 @@ class DistFFTPlan:
 
     # -- staged-execution helper (shared by slab/pencil/batched2d) ---------
 
-    def _jit_stages(self, specs):
+    def _jit_stages(self, specs: Sequence[Tuple[Any, ...]]) -> List[Any]:
+        # Staged execution only exists on multi-device plans (the
+        # single-device fallback never builds stage specs), so the mesh
+        # is always resolved here — narrow the Optional for mypy.
+        assert self.mesh is not None, "staged execution needs a device mesh"
         return jit_stages(self.mesh, specs)
 
 
-def jit_stages(mesh, specs):
+def jit_stages(mesh: Mesh,
+               specs: Sequence[Tuple[Any, ...]]) -> List[Tuple[Any, Any]]:
     """Jit each (desc, body, in_spec, out_spec) as its own shard_mapped
     program so per-phase timers can fence between them. Module-level so
     plans outside the DistFFTPlan hierarchy (Batched2DFFTPlan) share the

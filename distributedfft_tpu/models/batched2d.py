@@ -603,9 +603,54 @@ def _contract_exchanges(plan, direction, dims=2):
         plan.partition.num_ranks, rendering, chunks),)
 
 
+def _declare_graph(plan, direction, dims=2):
+    """Batched-2D stage graph (analysis/plangraph.py): ``shard="x"`` is
+    the 2D slab restriction — per-plane y FFT -> exchange -> per-plane
+    x FFT (encode/decode under a compressed wire; the fused wire uses
+    the unpack-only arrival — the post-transpose FFT runs along the
+    gathered axis); ``shard="batch"`` and the single-device fallback are
+    one collective-free fused 2D FFT node. Guard at check/enforce."""
+    del dims
+    from ..analysis import plangraph as _pg
+    cfg = plan.config
+    cdt, rdt = _pg.payload_dtypes(cfg, plan.transform)
+    fwd = direction == "forward"
+    b = _pg.GraphBuilder("batched2d", direction, wire=cfg.wire_dtype,
+                         guards=plan._guard_mode, complex_dtype=cdt)
+    in_shape = plan.input_padded_shape if fwd else plan.output_padded_shape
+    out_shape = plan.output_padded_shape if fwd else plan.input_padded_shape
+    in_dtype, out_dtype = (rdt, cdt) if fwd else (cdt, rdt)
+    in_spec = plan._in_spec if fwd else plan._out_spec
+    out_spec = plan._out_spec if fwd else plan._in_spec
+    b.node("input")
+    b.payload(in_shape, in_dtype, in_spec)
+    if plan.fft3d or plan.shard == "batch":
+        b.node("local_fft", axes=(2, 1) if fwd else (1, 2),
+               label="2D FFT per plane")
+        b.payload(out_shape, out_dtype, out_spec)
+    else:
+        (decl,) = _contract_exchanges(plan, direction)
+        b.node("local_fft", axes=(2,) if fwd else (1,), label="stage 1")
+        depth = _pg.shipped_schedule_depth(decl.rendering)
+        fused = cfg.fused_wire_active()
+        b.exchange(decl.label, decl.payload_shape, decl.axis_size,
+                   decl.rendering, chunks=decl.chunks,
+                   schedule_depth=depth, decoded_spec=out_spec,
+                   fused_encode=fused,
+                   decode_fuses=("decode",) if fused else None)
+        b.node("local_fft", axes=(1,) if fwd else (2,), label="stage 2")
+        b.payload(out_shape, out_dtype, out_spec)
+    if plan._guard_mode != "off":
+        b.node("guard")
+    b.node("output")
+    return b.graph()
+
+
 def _register_contracts():
     from ..analysis import contracts as _c
+    from ..analysis import plangraph as _pg
     _c.register_family("batched2d", "Batched2DFFTPlan", _contract_exchanges)
+    _pg.register_graph_family("batched2d", _declare_graph)
 
 
 _register_contracts()

@@ -892,9 +892,77 @@ def _contract_exchanges(plan, direction, dims=3):
     return tuple(out)
 
 
+def _declare_graph(plan, direction, dims=3):
+    """Pencil stage graph (analysis/plangraph.py): z FFT -> transpose 1
+    (p2 axis, present from dims >= 2 when p2 > 1) -> y FFT -> transpose
+    2 (p1 axis, from dims >= 3 when p1 > 1) -> x FFT, mirrored for the
+    inverse; encode/decode around each compressed exchange (the fused
+    wire uses the unpack-only arrival kernel — every pencil
+    post-transpose FFT runs along the gathered axis, so nothing
+    pipelines per block); guard at modes check/enforce."""
+    from ..analysis import plangraph as _pg
+    cfg = plan.config
+    cdt, rdt = _pg.payload_dtypes(cfg, plan.transform)
+    fwd = direction == "forward"
+    b = _pg.GraphBuilder("pencil", direction, wire=cfg.wire_dtype,
+                         guards=plan._guard_mode, complex_dtype=cdt)
+    decls = {d.label: d for d in _contract_exchanges(plan, direction, dims)}
+
+    def add_exchange(label, spec_after, second=False):
+        d = decls.get(label)
+        if d is None:
+            return
+        fused = cfg.fused_wire_active(second)
+        b.exchange(d.label, d.payload_shape, d.axis_size, d.rendering,
+                   chunks=d.chunks,
+                   schedule_depth=_pg.shipped_schedule_depth(d.rendering),
+                   decoded_spec=spec_after, fused_encode=fused,
+                   decode_fuses=("decode",) if fused else None)
+
+    if fwd:
+        b.node("input")
+        b.payload(plan.input_padded_shape, rdt, plan.input_spec)
+        if plan.fft3d:
+            b.node("local_fft", axes=tuple((2, 1, 0)[:dims]),
+                   label="fft3d")
+        else:
+            b.node("local_fft", axes=(2,), label="z stage")
+            if dims >= 2:
+                add_exchange("transpose 1", plan._mid_spec)
+                b.node("local_fft", axes=(1,), label="y stage")
+            if dims >= 3:
+                add_exchange("transpose 2", plan._out_spec, second=True)
+                b.node("local_fft", axes=(0,), label="x stage")
+        b.payload(plan.output_padded_shape_for(dims), cdt,
+                  plan.spec_for(dims) if not plan.fft3d else "")
+    else:
+        b.node("input")
+        b.payload(plan.output_padded_shape_for(dims), cdt,
+                  plan.spec_for(dims) if not plan.fft3d else "")
+        if plan.fft3d:
+            b.node("local_fft", axes=tuple(reversed((2, 1, 0)[:dims])),
+                   label="fft3d")
+        else:
+            if dims >= 3:
+                b.node("local_fft", axes=(0,), label="x stage")
+                add_exchange("transpose 2", plan._mid_spec, second=True)
+            if dims >= 2:
+                b.node("local_fft", axes=(1,), label="y stage")
+                add_exchange("transpose 1", plan._in_spec)
+            b.node("local_fft", axes=(2,), label="z stage")
+        b.payload(plan.input_padded_shape, rdt,
+                  plan.input_spec if not plan.fft3d else "")
+    if plan._guard_mode != "off":
+        b.node("guard")
+    b.node("output")
+    return b.graph()
+
+
 def _register_contracts():
     from ..analysis import contracts as _c
+    from ..analysis import plangraph as _pg
     _c.register_family("pencil", "PencilFFTPlan", _contract_exchanges)
+    _pg.register_graph_family("pencil", _declare_graph)
 
 
 _register_contracts()

@@ -888,9 +888,67 @@ def _contract_exchanges(plan, direction, dims=3):
                             plan._P, rendering, chunks),)
 
 
+def _declare_graph(plan, direction, dims=3):
+    """Slab stage graph (analysis/plangraph.py): stage-1 local FFTs
+    (the sequence's R2C axis + pre axes) -> one symmetric exchange
+    (encode/decode around it under a compressed wire; fused Pallas
+    kernels when ``Config.fused_wire`` is active) -> stage-2 local FFTs
+    (post axes) -> guard (modes check/enforce). The single-device
+    fallback is one fused local-FFT node."""
+    from ..analysis import plangraph as _pg
+    cfg = plan.config
+    c2c = plan.transform == "c2c"
+    cdt, rdt = _pg.payload_dtypes(cfg, plan.transform)
+    fwd = direction == "forward"
+    b = _pg.GraphBuilder("slab", direction, wire=cfg.wire_dtype,
+                         guards=plan._guard_mode, complex_dtype=cdt)
+    in_shape = plan.input_padded_shape if fwd else plan.output_padded_shape
+    out_shape = plan.output_padded_shape if fwd else plan.input_padded_shape
+    in_dtype, out_dtype = (rdt, cdt) if fwd else (cdt, rdt)
+    b.node("input")
+    b.payload(in_shape, in_dtype,
+              plan.input_spec if fwd else plan.output_spec)
+    if plan.fft3d:
+        b.node("local_fft", axes=(2, 1, 0) if fwd else (0, 1, 2),
+               label="fft3d")
+        b.payload(out_shape, out_dtype, "")
+    else:
+        s = plan._seq
+        (decl,) = _contract_exchanges(plan, direction, dims)
+        if fwd:
+            stage1 = (s.r2c_axis,) + s.pre_axes
+            stage2 = s.post_axes
+            pipe_axes = tuple(a for a in s.post_axes if a != 0)
+        else:
+            stage1 = tuple(reversed(s.post_axes))
+            stage2 = tuple(reversed(s.pre_axes)) + (s.r2c_axis,)
+            pipe_axes = tuple(a for a in reversed(s.pre_axes)
+                              if a != s.split_axis)
+            if c2c and s.r2c_axis != s.split_axis:
+                pipe_axes += (s.r2c_axis,)
+        b.node("local_fft", axes=stage1, label="stage 1")
+        depth = _pg.shipped_schedule_depth(decl.rendering)
+        fused = cfg.fused_wire_active()
+        spec_after = plan.output_spec if fwd else plan.input_spec
+        b.exchange(decl.label, decl.payload_shape, decl.axis_size,
+                   decl.rendering, chunks=decl.chunks,
+                   schedule_depth=depth, decoded_spec=spec_after,
+                   fused_encode=fused,
+                   decode_fuses=(("decode", "fft") if pipe_axes
+                                 else ("decode",)) if fused else None)
+        b.node("local_fft", axes=stage2, label="stage 2")
+        b.payload(out_shape, out_dtype, spec_after)
+    if plan._guard_mode != "off":
+        b.node("guard")
+    b.node("output")
+    return b.graph()
+
+
 def _register_contracts():
     from ..analysis import contracts as _c
+    from ..analysis import plangraph as _pg
     _c.register_family("slab", "SlabFFTPlan", _contract_exchanges)
+    _pg.register_graph_family("slab", _declare_graph)
 
 
 _register_contracts()

@@ -41,6 +41,10 @@ from __future__ import annotations
 import argparse
 import sys
 
+# Shared with the graph section so explain and dfft-verify format bytes
+# identically (the analysis chain is jax-free at import).
+from ..analysis.plangraph import _fmt_bytes
+
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
@@ -101,14 +105,6 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def _fmt_bytes(n: int) -> str:
-    if n >= 1 << 30:
-        return f"{n / (1 << 30):.2f} GiB"
-    if n >= 1 << 20:
-        return f"{n / (1 << 20):.2f} MiB"
-    if n >= 1 << 10:
-        return f"{n / (1 << 10):.2f} KiB"
-    return f"{n} B"
 
 
 def _rendering(comm, send, opt, p: int, fused_wire: bool = False) -> str:
@@ -370,6 +366,30 @@ def _roofline_lines(args, kind: str, backend: str) -> list:
     return lines
 
 
+def _graph_lines(plan, dims: int) -> list:
+    """The ``graph:`` section: the declared stage graph (nodes, per-edge
+    wire bytes, ring schedule depth) from the SAME plangraph registry
+    ``dfft-verify`` checks — explain cannot disagree with the verifier
+    about what pipeline this plan declares. Purely declarative (nothing
+    compiles); a family without a declaration is reported, the exact
+    condition the verify matrix fails on."""
+    from ..analysis import plangraph
+    try:
+        graph = plangraph.graph_for(plan, "forward", dims)
+    except plangraph.MissingGraph as e:
+        return [f"  none declared ({e}) — dfft-verify fails this combo"]
+    lines = plangraph.format_graph(graph)
+    findings = plangraph.check_graph(graph)
+    if findings:
+        lines += [f"  WELL-FORMEDNESS VIOLATION: {v}" for v in findings]
+    else:
+        lines.append(
+            f"  well-formed: {len(graph.nodes)} node(s) checked "
+            "(dataflow, wire pairing, dtype flow, payload, guard "
+            "arity, ring-schedule hazards)")
+    return lines
+
+
 def _census_lines(compiled) -> list:
     from ..testing.microbench import async_collective_counts
     c = async_collective_counts(compiled)
@@ -596,6 +616,9 @@ def main(argv=None) -> int:
                    + (f" (mxu_precision={cfg.mxu_precision}, "
                       f"mxu_direct_max={cfg.mxu_direct_max})"
                       if cfg.fft_backend.startswith("matmul") else ""))
+
+        out.append("graph (declared stage graph, plangraph registry):")
+        out.extend(_graph_lines(plan, dims))
 
         sched = _schedule_lines(xmeta, cdt, cfg)
         if sched:

@@ -435,28 +435,37 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
 
 
 def ring_schedule(payload_shape, dtype, wire: str, p: int,
-                  overlap: bool = False) -> dict:
+                  overlap: bool = False, depth: int = 2) -> dict:
     """Static description of a ring exchange's schedule over a GLOBAL
     padded payload of ``payload_shape`` (what ``dfft-explain`` prints for
     a resolved RING/RING_OVERLAP plan): ``steps`` permutes per device,
-    ``buffers`` revolving receive buffers (2 under the double-buffered
-    overlap schedule, 1 for the plain ring), the per-device travelling
-    block's wire bytes (one P-th of the local shard — the unit in flight
-    on each step), the peak bytes in flight per device, and the total
-    wire bytes across the mesh (the ``(P-1)/P`` ring discount: the local
-    block never travels)."""
+    ``buffers`` revolving receive buffers (``depth`` under the
+    revolving-buffer overlap schedule — the shipped double-buffered
+    pipeline is ``depth=2``; 1 for the plain ring), the per-device
+    travelling block's wire bytes (one P-th of the local shard — the
+    unit in flight on each step), the peak bytes in flight per device,
+    and the total wire bytes across the mesh (the ``(P-1)/P`` ring
+    discount: the local block never travels).
+
+    ``depth`` > 2 describes the generalized D-way revolving pipeline
+    (ROADMAP item 3's autotune axis); ``analysis/schedverify.py``
+    statically proves the generated schedule hazard-free at any depth
+    before a plan may trace it."""
+    if depth < 1:
+        raise ValueError(f"buffer depth must be >= 1, got {depth}")
     total = wire_nbytes(payload_shape, dtype, wire)
     block = total // (p * p) if p > 1 else total
     steps = max(0, p - 1)
+    buffers = depth if overlap else 1
     return {
         "steps": steps,
-        "buffers": 2 if overlap else 1,
+        "buffers": buffers,
         "block_wire_bytes": block,
         # One transfer in flight while the previous block computes: the
-        # overlap schedule holds two block-sized buffers live per device
-        # (the in-flight and the computing block); the plain ring holds
-        # one.
-        "bytes_in_flight": block * (2 if overlap else 1),
+        # overlap schedule holds ``depth`` block-sized buffers live per
+        # device (the in-flight and the computing blocks); the plain
+        # ring holds one.
+        "bytes_in_flight": block * buffers,
         "total_wire_bytes": total * steps // p if p > 1 else 0,
     }
 

@@ -16,6 +16,7 @@
 """
 
 import dataclasses
+import os
 import subprocess
 import sys
 
@@ -162,6 +163,10 @@ def test_dfft_verify_cli_mutation_selftest():
     assert "mutation self-test: PASS" in r.stdout
     assert "unpaired wire_encode/wire_decode" in r.stdout
     assert "census all_to_all == 2" in r.stdout
+    # The graph-defect mutations (ISSUE 11) ride the same self-test.
+    assert "mutation drop-decode-node: CAUGHT" in r.stdout
+    assert "mutation phantom-exchange: CAUGHT" in r.stdout
+    assert "mutation hazard-schedule: CAUGHT" in r.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -354,6 +359,52 @@ def test_srclint_wisdom_flock_detector():
               "    with _advisory_lock(path):\n"
               "        os.replace('tmp', path)\n")
     assert srclint.lint_source(locked, "x/utils/wisdom.py") == []
+
+
+def test_srclint_scans_serve_and_solvers():
+    """The post-PR-6 packages are inside the lint scope (ISSUE 11): the
+    walk visits them, and the replace-under-lock rule applies to their
+    modules — an unlocked os.replace in serve/ or solvers/ is flagged
+    exactly like one in the wisdom store."""
+    files = srclint.scanned_files()
+    for suffix in ("serve/server.py", "serve/plancache.py",
+                   "solvers/navier_stokes.py", "solvers/poisson.py"):
+        assert any(f.replace("\\", "/").endswith(suffix) for f in files), \
+            f"{suffix} outside the srclint walk"
+    unlocked = ("import os\n"
+                "def spill(path, data):\n"
+                "    os.replace('tmp', path)\n")
+    for path in ("x/serve/plancache.py", "x/solvers/checkpoint.py"):
+        assert [f.rule for f in srclint.lint_source(unlocked, path)] == \
+            ["wisdom-flock"], path
+    # Unconstrained elsewhere; locked form clean inside the scope.
+    assert srclint.lint_source(unlocked, "x/models/slab.py") == []
+    # The scope anchors on in-package components, not the checkout
+    # path: an absolute prefix containing "serve" must not widen the
+    # rule to the whole repo.
+    assert srclint.lint_source(
+        unlocked, "/home/serve/pkg/models/slab.py") == []
+    in_pkg = os.path.join(srclint.package_root(), "models", "fake.py")
+    assert srclint.lint_source(unlocked, in_pkg) == []
+    locked = ("import os\n"
+              "def _advisory_lock(p):\n"
+              "    yield\n"
+              "def spill(path, data):\n"
+              "    with _advisory_lock(path):\n"
+              "        os.replace('tmp', path)\n")
+    assert srclint.lint_source(locked, "x/serve/plancache.py") == []
+
+
+def test_srclint_traced_host_io_applies_in_serve():
+    """traced-host-io fires on serve/-pathed sources too (the rule is
+    path-independent; this pins the scope claim)."""
+    src = ("import os\nimport jax\n"
+           "def body(x):\n"
+           "    os.environ.get('K')\n"
+           "    return x\n"
+           "f = jax.jit(body)\n")
+    found = srclint.lint_source(src, "x/serve/worker.py")
+    assert [f.rule for f in found] == ["traced-host-io"]
 
 
 def test_srclint_repo_clean():

@@ -1,0 +1,319 @@
+"""Plan-graph IR (``analysis/plangraph.py``) + schedule hazard checker
+(``analysis/schedverify.py``) tests.
+
+* COMPLETENESS: every registered family declares a well-formed,
+  contract-consistent stage graph for EVERY rendering x direction x
+  wire x guard combo of the verify matrix (no silent gaps — the exact
+  property the CI verify job enforces per combo);
+* graph <-> trace conformance on representative combos (the full
+  matrix runs as the CI job), and the graph-defect mutations (dropped
+  decode node, phantom exchange, hazardous schedule) are CAUGHT with
+  the right diagnostic;
+* hazard-checker units: the generalized revolving schedule is clean at
+  depths 1/2/4/8 across ring sizes (single-peer degenerate included),
+  every synthetic hazard class is detected, and the byte accounting
+  composes with ``transpose.ring_schedule`` (uneven payloads included);
+* ``dfft-explain``'s graph section comes from the same registry.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import params as pm
+from distributedfft_tpu.analysis import (
+    contracts,
+    plangraph,
+    schedverify,
+    verify,
+)
+from distributedfft_tpu.parallel.transpose import ring_schedule
+
+G = dfft.GlobalSize(20, 16, 16)  # uneven: padding on every decomposed axis
+
+
+def _slab(cfg_kw, seq="ZY_Then_X"):
+    return dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                            dfft.Config(use_wisdom=False, **cfg_kw),
+                            sequence=seq)
+
+
+# ---------------------------------------------------------------------------
+# completeness: a graph for EVERY combo of the verify matrix
+# ---------------------------------------------------------------------------
+
+def test_every_matrix_combo_declares_a_wellformed_graph(devices):
+    """No silent gaps: every combo ``dfft-verify`` sweeps must resolve
+    a graph that passes well-formedness AND reconciles with the
+    family's exchange contract (graph construction never compiles, so
+    the whole matrix is cheap here; trace conformance is the CI job)."""
+    args = verify.build_parser().parse_args([])
+    combos = list(verify.iter_combos(args, 8))
+    assert len(combos) >= 171
+    seen_families = set()
+    for combo in combos:
+        if combo.get("bluestein"):
+            plan, dims = dfft.SlabFFTPlan(
+                dfft.GlobalSize(20, 16, 19), pm.SlabPartition(8),
+                dfft.Config(fft_backend="bluestein", use_wisdom=False)), 3
+        elif combo.get("single"):
+            plan, dims = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
+                                          pm.SlabPartition(1),
+                                          dfft.Config(use_wisdom=False)), 3
+        elif combo.get("batch_shard"):
+            plan, dims = dfft.Batched2DFFTPlan(
+                8, 20, 16, pm.SlabPartition(8),
+                dfft.Config(use_wisdom=False), shard="batch"), 2
+        else:
+            plan, dims = verify._make_plan(
+                combo["family"], combo["rendering"], combo["wire"],
+                combo["guards"], combo["sequence"] or "ZY_Then_X", 8)
+        graph = plangraph.graph_for(plan, combo["direction"], dims)
+        seen_families.add(graph.family)
+        findings = plangraph.check_graph(graph)
+        findings += plangraph.check_graph_contract(
+            graph, contracts.contract_for(plan, combo["direction"], dims))
+        assert findings == [], (combo, [str(f) for f in findings])
+    assert seen_families == {"slab", "pencil", "batched2d"}
+
+
+def test_missing_graph_declaration_is_a_combo_failure(devices):
+    """An unregistered family fails the combo with a named diagnostic,
+    never a skip."""
+    plan = _slab(dict(opt=1))
+    saved = plangraph._GRAPH_FAMILIES.pop("slab")
+    try:
+        with pytest.raises(plangraph.MissingGraph):
+            plangraph.graph_for(plan, "forward")
+        res = verify.run_combo(dict(family="slab", rendering="opt1",
+                                    sequence="ZY_Then_X", wire="native",
+                                    guards="off", direction="forward"), 8)
+        assert not res["ok"]
+        assert any("no stage graph declared" in v
+                   for v in res["violations"])
+    finally:
+        plangraph._GRAPH_FAMILIES["slab"] = saved
+
+
+# ---------------------------------------------------------------------------
+# graph <-> trace conformance (representative combos)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(comm_method=pm.CommMethod.ALL2ALL, opt=1),
+    dict(send_method=pm.SendMethod.RING_OVERLAP, wire_dtype="bf16",
+         fused_wire=True),
+    dict(comm_method=pm.CommMethod.PEER2PEER, wire_dtype="bf16",
+         guards="check"),
+], ids=["opt1", "fused-ring-ovl", "p2p-bf16-check"])
+@pytest.mark.parametrize("direction", ["forward", "inverse"])
+def test_slab_graph_verifies_against_trace(devices, kw, direction):
+    assert plangraph.verify_graph(_slab(kw), direction) == []
+
+
+def test_pencil_mixed_rendering_graph(devices):
+    """Mixed per-transpose renderings: t1 ring over p2, t2 a2a over p1
+    — the graph carries both, with the ring's schedule depth."""
+    plan = dfft.PencilFFTPlan(
+        G, pm.PencilPartition(2, 4),
+        dfft.Config(send_method=pm.SendMethod.RING_OVERLAP,
+                    comm_method2=pm.CommMethod.ALL2ALL,
+                    send_method2=pm.SendMethod.SYNC, use_wisdom=False))
+    graph = plangraph.graph_for(plan, "forward")
+    x1, x2 = graph.exchanges()
+    assert (x1.rendering, x1.schedule_depth) == ("ring_overlap", 2)
+    assert (x2.rendering, x2.schedule_depth) == ("a2a", 0)
+    assert plangraph.verify_graph(plan, "forward") == []
+
+
+def test_graph_wire_bytes_carry_ring_discount(devices):
+    plan = _slab(dict(send_method=pm.SendMethod.RING, wire_dtype="bf16"))
+    graph = plangraph.graph_for(plan, "forward")
+    (x,) = graph.exchanges()
+    (edge,) = graph.in_edges(x.id)
+    # (24, 16, 9) bf16 wire (4 B/elem), 7/8 travelling.
+    assert edge.wire_bytes == 24 * 16 * 9 * 4 * 7 // 8
+    assert edge.dtype == "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# graph-defect mutations: the pass must FAIL with the right diagnostic
+# ---------------------------------------------------------------------------
+
+def test_mutation_drop_decode_node_caught(devices):
+    res = verify.run_mutation("drop-decode-node", 8)
+    assert any("unpaired encode/decode" in v for v in res["violations"])
+    assert any("plangraph/" in v and "wire-pairing" in v
+               for v in res["violations"])
+
+
+def test_mutation_phantom_exchange_caught(devices):
+    res = verify.run_mutation("phantom-exchange", 8)
+    assert any("phantom exchange" in v for v in res["violations"])
+    assert any("trace-conformance" in v for v in res["violations"])
+
+
+def test_mutation_hazard_schedule_caught(devices):
+    res = verify.run_mutation("hazard-schedule", 8)
+    assert any("write-after-send" in v for v in res["violations"])
+
+
+def test_graph_payload_mutation_caught(devices):
+    """A graph whose exchange edge claims the wrong wire bytes fails
+    payload conservation."""
+    graph = plangraph.graph_for(_slab(dict(opt=1)), "forward")
+    edges = tuple(dataclasses.replace(e, wire_bytes=e.wire_bytes * 2)
+                  if e.wire_bytes else e for e in graph.edges)
+    bad = dataclasses.replace(graph, edges=edges)
+    findings = plangraph.check_graph(bad)
+    assert any(f.check == "payload" for f in findings)
+
+
+def test_graph_dtype_drift_mutation_caught(devices):
+    """A decode restoring the wrong float width fails dtype-flow."""
+    graph = plangraph.graph_for(
+        _slab(dict(send_method=pm.SendMethod.RING, wire_dtype="bf16")),
+        "forward")
+    dec = next(n for n in graph.nodes if n.decodes())
+    edges = tuple(dataclasses.replace(e, dtype="complex128")
+                  if e.src == dec.id else e for e in graph.edges)
+    bad = dataclasses.replace(graph, edges=edges)
+    findings = plangraph.check_graph(bad)
+    assert any(f.check == "dtype-flow" for f in findings)
+
+
+def test_graph_guard_arity_mutation_caught(devices):
+    """A guard node present in a guards="off" graph is a violation (and
+    a guarded graph missing its node equally)."""
+    off = plangraph.graph_for(_slab(dict(opt=1)), "forward")
+    on = plangraph.graph_for(_slab(dict(opt=1, guards="check")), "forward")
+    assert [n.kind for n in off.nodes].count("guard") == 0
+    assert [n.kind for n in on.nodes].count("guard") == 1
+    swapped = dataclasses.replace(on, guards="off")
+    assert any(f.check == "guard-arity"
+               for f in plangraph.check_graph(swapped))
+    swapped = dataclasses.replace(off, guards="check")
+    assert any(f.check == "guard-arity"
+               for f in plangraph.check_graph(swapped))
+
+
+def test_graph_cycle_and_orphan_caught(devices):
+    graph = plangraph.graph_for(_slab(dict(opt=1)), "forward")
+    orphan = plangraph.StageNode(id="local_fft:9", kind="local_fft")
+    bad = dataclasses.replace(graph, nodes=graph.nodes + (orphan,))
+    assert any("input->output path" in f.message
+               for f in plangraph.check_graph(bad))
+    e = graph.edges[-1]
+    cyc = dataclasses.replace(graph, edges=graph.edges + (
+        dataclasses.replace(e, src=e.dst, dst=graph.edges[0].dst),))
+    assert any("cycle" in f.message for f in plangraph.check_graph(cyc))
+
+
+# ---------------------------------------------------------------------------
+# schedule hazard checker units
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [1, 2, 4, 8])
+@pytest.mark.parametrize("p", [1, 2, 3, 5, 8])
+def test_revolving_schedule_clean(p, depth):
+    """The generalized revolving pipeline is hazard-free at every
+    autotune-candidate depth x ring size — uneven (steps not a multiple
+    of depth, p=3/5) and degenerate (p=1: empty; p=2: one step) cases
+    included."""
+    ops = schedverify.revolving_schedule(p, depth)
+    assert schedverify.check_schedule(ops, p, depth) == []
+    if p == 1:
+        assert ops == ()
+
+
+def test_depth2_matches_shipped_ring_overlap_order():
+    """Depth 2 reproduces the shipped RING_OVERLAP issue order: step
+    t+1's permute issued BEFORE block t's compute (the pipeline
+    property the overlap exists for)."""
+    ops = schedverify.revolving_schedule(8, 2)
+    for t in range(1, 7):
+        issue_next = next(i for i, o in enumerate(ops)
+                          if o.op == "issue" and o.step == t + 1)
+        compute_t = next(i for i, o in enumerate(ops)
+                         if o.op == "compute" and o.step == t)
+        assert issue_next < compute_t, f"step {t + 1} not overlapped"
+
+
+@pytest.mark.parametrize("kind", schedverify.HAZARD_KINDS)
+def test_every_hazard_class_caught(kind):
+    bad = schedverify.mutated_schedule(kind, 8, 2)
+    hazards = schedverify.check_schedule(bad, 8, 2)
+    assert any(h.kind == kind for h in hazards), \
+        (kind, [str(h) for h in hazards])
+
+
+def test_hazards_caught_at_every_depth():
+    for depth in (2, 4, 8):
+        for kind in ("read-before-arrive", "write-after-send"):
+            bad = schedverify.mutated_schedule(kind, 8, depth)
+            assert any(h.kind == kind for h in
+                       schedverify.check_schedule(bad, 8, depth))
+
+
+def test_describe_composes_ring_schedule_bytes():
+    """describe() joins the timeline verdict with transpose.ring_schedule
+    byte accounting — uneven payload, depth 4."""
+    d = schedverify.describe(8, 4, payload_shape=(24, 16, 9),
+                             dtype=np.complex64, wire="bf16")
+    assert d["ok"] and d["depth"] == 4
+    total = 24 * 16 * 9 * 4
+    assert d["bytes"]["buffers"] == 4
+    assert d["bytes"]["block_wire_bytes"] == total // 64
+    assert d["bytes"]["bytes_in_flight"] == 4 * (total // 64)
+    assert d["bytes"]["total_wire_bytes"] == total * 7 // 8
+
+
+def test_ring_schedule_depth_parameter():
+    """transpose.ring_schedule grew the depth axis (ROADMAP item 3);
+    defaults stay byte-for-byte what PR 10 shipped."""
+    legacy = ring_schedule((256, 256, 129), np.complex64, "bf16", 8,
+                           overlap=True)
+    assert legacy["buffers"] == 2
+    deep = ring_schedule((256, 256, 129), np.complex64, "bf16", 8,
+                         overlap=True, depth=8)
+    assert deep["buffers"] == 8
+    assert deep["bytes_in_flight"] == 8 * deep["block_wire_bytes"]
+    with pytest.raises(ValueError):
+        ring_schedule((8, 8), np.complex64, "native", 4, depth=0)
+
+
+def test_verify_shipped_depths_sweep():
+    rows = schedverify.verify_shipped_depths(8)
+    assert [r["depth"] for r in rows] == [1, 1, 2, 4, 8]
+    assert all(r["ok"] for r in rows)
+    # Honesty about the p-1 buffer cap: an 8-rank ring has 7 steps, so
+    # the depth-8 row exercises only 7 buffers and must say so.
+    assert [r["effective_depth"] for r in rows] == [0, 1, 2, 4, 7]
+    assert schedverify.describe(16, 8)["effective_depth"] == 8
+
+
+def test_shipped_schedule_depth_helper():
+    """The single depth source the three family declarations share."""
+    assert plangraph.shipped_schedule_depth("ring_overlap") == 2
+    assert plangraph.shipped_schedule_depth("ring") == 1
+    for rendering in ("a2a", "streams", "p2p", "none"):
+        assert plangraph.shipped_schedule_depth(rendering) == 0
+
+
+# ---------------------------------------------------------------------------
+# explain prints the graph from the same registry
+# ---------------------------------------------------------------------------
+
+def test_explain_graph_section(devices, capsys):
+    from distributedfft_tpu.obs import explain
+    rc = explain.main(["--kind", "slab", "-nx", "20", "-ny", "16",
+                       "-nz", "16", "-p", "8", "-snd", "RingOverlap",
+                       "-wire", "bf16", "--no-compile"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "graph (declared stage graph" in out
+    assert "exchange[ring_overlap P=8 depth=2]" in out
+    assert "well-formed:" in out
+    assert "on the wire (schedule depth 2)" in out

@@ -181,6 +181,27 @@ def _roofline_for_sizes(sizes: dict, backend: str,
     return rows
 
 
+def _stage_profile_brief(prof: dict) -> dict:
+    """Compress one ``obs.profile.stage_profile`` report to the
+    BENCH_DETAILS.json ``"stage_profile"`` row shape: the headline split
+    plus one compact row per executing node (input/output and trace-file
+    bookkeeping dropped)."""
+    stages = []
+    for row in prof.get("stages", []):
+        if row.get("kind") in ("input", "output"):
+            continue
+        r = {"node": row["node"], "kind": row["kind"],
+             "device_ms": row["device_ms"], "fraction": row["fraction"]}
+        for k in ("ideal_ms", "gap_x", "note", "approx"):
+            if k in row:
+                r[k] = row[k]
+        stages.append(r)
+    return {k: prof[k] for k in
+            ("family", "direction", "iters", "total_ms", "attributed_ms",
+             "unattributed_ms", "exchange_ms", "compute_ms",
+             "exchange_fraction") if k in prof} | {"stages": stages}
+
+
 def _fold_obs_metrics(out: dict) -> None:
     """Attach the obs metrics snapshot (wisdom hits/misses, race cells,
     wire bytes, HLO census gauges) to a child's JSON record when anything
@@ -847,6 +868,38 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
                 raise
             except Exception as e:  # noqa: BLE001 — optional diagnostics
                 out["mesh_sequence_error"] = f"{type(e).__name__}: {e}"
+
+        # Stage-attributed device profile (ISSUE 12): the slab forward at
+        # the mesh size under jax.profiler, device time joined onto the
+        # declared plan-graph nodes — plus the RING vs RING_OVERLAP pair
+        # at a small size, so ROADMAP item 3's overlap decision is
+        # ATTRIBUTED (which stage's time moved), not just timed. Guarded
+        # and headroom-gated: attribution extras never cost the core
+        # metrics or the deadline.
+        if time.monotonic() - t_child0 > 0.7 * MESH_TIMEOUT_S:
+            out["stage_profile_error"] = \
+                "skipped: mesh child deadline headroom"
+        else:
+            try:
+                from distributedfft_tpu.obs import profile as prof_mod
+                sp = {"alltoall": _stage_profile_brief(
+                    prof_mod.stage_profile(plan, "forward", 3, iters=2))}
+                ng = 64
+                gg = dfft.GlobalSize(ng, ng, ng)
+                for label, snd in (("ring", dfft.SendMethod.RING),
+                                   ("ring_overlap",
+                                    dfft.SendMethod.RING_OVERLAP)):
+                    op = dfft.SlabFFTPlan(gg, dfft.SlabPartition(p),
+                                          dfft.Config(send_method=snd),
+                                          sequence="Z_Then_YX")
+                    sp[label] = _stage_profile_brief(
+                        prof_mod.stage_profile(op, "forward", 3, iters=2))
+                    sp[label]["n"] = ng
+                out["stage_profile"] = sp
+            except TimeoutError:
+                raise
+            except Exception as e:  # noqa: BLE001 — attribution extra
+                out["stage_profile_error"] = f"{type(e).__name__}: {e}"
 
         # CPU fallback roundtrip (used as the headline only if the TPU path is
         # unreachable; CPU timers are reliable so a short chain suffices).
@@ -1592,6 +1645,15 @@ def main() -> int:
             # Obs registry snapshot of the mesh child (wisdom hits/misses,
             # race cells, per-shard wire bytes, HLO census gauges).
             result["obs_metrics_mesh"] = mesh["obs_metrics"]
+        if mesh.get("stage_profile"):
+            # Stage-attributed device profile (ISSUE 12): per-node device
+            # time joined onto the declared plan graph — the all-to-all
+            # slab at the mesh size plus the RING vs RING_OVERLAP pair at
+            # 64^3, so the overlap decision is attributed (which stage's
+            # time moved), not just timed.
+            result["stage_profile"] = mesh["stage_profile"]
+        elif mesh.get("stage_profile_error"):
+            result["stage_profile_error"] = mesh["stage_profile_error"]
     if serve:
         # Serving-layer saturation record (ISSUE 8): cold vs warm-cache
         # latency and the offered-load sweep (p50/p99, FFTs/sec, shed,

@@ -164,6 +164,19 @@ def family_of(plan: Any) -> str:
     return fam
 
 
+def scope_family(plan: Any) -> str:
+    """The family key a plan's stage scopes are named under
+    (``dfft/<family>/<node-id>``; ``obs/profile.py``): the registered
+    contract family, falling back to the class name for plan types the
+    registry does not know. The ONE resolution both the models' scope
+    emission and the guard layer use, so scope names can never disagree
+    between emitters."""
+    try:
+        return family_of(plan)
+    except KeyError:
+        return type(plan).__name__.lower()
+
+
 def rendering_name(config: Any, second: bool = False) -> str:
     """The rendering key one transpose resolves to from a (concrete)
     Config — the same classification ``dfft-explain`` prints."""

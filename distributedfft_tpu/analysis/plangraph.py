@@ -567,6 +567,60 @@ def check_graph(graph: PlanGraph) -> List[GraphViolation]:
 
 
 # ---------------------------------------------------------------------------
+# (a2) stage-scope conformance (obs/profile.py attribution; ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def _scoped_nodes(graph: PlanGraph) -> List[Tuple[StageNode, str]]:
+    """``(node, expected scope string)`` for every declared node whose
+    ops the build emits under a stage scope. Exempt: input/output
+    (structural), GSPMD (``p2p``) exchanges (the partitioner inserts the
+    collective at the stage boundary — there is no explicit op region to
+    wrap), and guard nodes under ``guards="off"`` (none declared)."""
+    from ..obs import profile
+
+    out: List[Tuple[StageNode, str]] = []
+    for n in graph.nodes:
+        if n.kind in ("input", "output"):
+            continue
+        if n.kind == "exchange":
+            if n.rendering == "p2p":
+                continue
+            out.append((n, profile.scope_name(graph.family, n.id)))
+        elif n.kind in ("local_fft", "guard"):
+            out.append((n, profile.scope_name(graph.family, n.id)))
+        elif n.encodes():
+            out.append((n, profile.scope_name("wire", "encode")))
+        elif n.decodes():
+            out.append((n, profile.scope_name("wire", "decode")))
+    return out
+
+
+def check_graph_scopes(graph: PlanGraph,
+                       compiled_txt: str) -> List[GraphViolation]:
+    """Every declared node with an op region must leave its
+    ``dfft/<family>/<node-id>`` stage scope in the compiled module's op
+    metadata (``jax.named_scope`` — metadata ONLY: the metadata-stripped
+    fingerprint pins prove a scope never adds ops; this check proves the
+    converse, that no declared stage is missing its scope, so
+    ``obs/profile.py`` attribution can never silently drop a stage).
+    Skipped when scopes are disabled (``profile.disable_scopes()`` /
+    ``$DFFT_NO_STAGE_SCOPES`` — the pins' comparison side)."""
+    from ..obs import profile
+
+    if not profile.scopes_enabled():
+        return []
+    out: List[GraphViolation] = []
+    for node, scope in _scoped_nodes(graph):
+        if scope not in compiled_txt:
+            out.append(_viol(
+                graph, "scope-conformance",
+                f"declared node {node.id!r} left no stage scope "
+                f"{scope!r} in the compiled module metadata — its "
+                "device time would be unattributable"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # (b) graph <-> contract and graph <-> trace conformance
 # ---------------------------------------------------------------------------
 
@@ -685,14 +739,19 @@ def check_graph_trace(plan: Any, graph: PlanGraph,
 def verify_graph(plan: Any, direction: str = "forward",
                  dims: int = 3) -> List[GraphViolation]:
     """The one-call graph pass over a live plan: resolve the declared
-    graph, run well-formedness, contract conformance and trace
-    conformance. The per-combo entry ``dfft-verify`` inlines (sharing
-    its compile)."""
+    graph, run well-formedness, contract conformance, trace conformance
+    and stage-scope conformance. The per-combo entry ``dfft-verify``
+    inlines (sharing its compile)."""
+    from . import hloscan
+
     graph = graph_for(plan, direction, dims)
     out = check_graph(graph)
     out += check_graph_contract(
         graph, contracts.contract_for(plan, direction, dims))
-    out += check_graph_trace(plan, graph, direction, dims)
+    txt = hloscan.compiled_text(plan, direction, dims)
+    out += check_graph_trace(plan, graph, direction, dims,
+                             compiled_txt=txt)
+    out += check_graph_scopes(graph, txt)
     return out
 
 

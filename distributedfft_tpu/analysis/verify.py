@@ -256,6 +256,11 @@ def run_combo(combo: Dict[str, Any], ndev: int,
             plan, graph, direction, dims, census=census,
             compiled_txt=txt, staged=staged, _staged_resolved=True,
             jaxpr=jaxpr)]
+        # Stage-scope conformance (ISSUE 12): every declared node's
+        # dfft/<family>/<node-id> scope must survive into the compiled
+        # module's metadata (shared compile — same txt as above).
+        violations += [str(v) for v in
+                       plangraph.check_graph_scopes(graph, txt)]
     if not no_jaxprlint:
         violations += [str(f) for f in
                        jaxprlint.lint_plan(plan, direction, dims,
@@ -345,6 +350,19 @@ def run_pins(ndev: int, families: Sequence[str]) -> List[Dict[str, Any]]:
                 ok=fp(guards="enforce") == checked,
                 detail="guards=enforce compiles the op graph of "
                        "guards=check"))
+            # Scope zero-overhead pin (ISSUE 12): the stage scopes the
+            # families emit for obs/profile attribution are METADATA
+            # ONLY — the metadata-stripped op graph with scopes on is
+            # byte-identical to scopes off (a scope that introduces ops
+            # is a combo failure, caught right here).
+            from distributedfft_tpu.obs import profile as _profile
+            with _profile.scopes_off():
+                scopeless = fp()
+            out.append(dict(
+                pin=f"{family}/scope-zero-overhead",
+                ok=scopeless == base,
+                detail="named stage scopes on == off after metadata "
+                       "strip (scopes never add ops)"))
     finally:
         _tracing._FORCED_DIR, _tracing._FORCE_OFF = prev_state
     return out

@@ -73,6 +73,13 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                          "the deep-dive complement to the per-phase Timer "
                          "CSVs, SURVEY §5 tracing; obs span names appear on "
                          "the trace as dfft:* annotations")
+    ap.add_argument("--profile-stages", action="store_true",
+                    help="after the run, measure a short stage-attributed "
+                         "device profile: a few forward iterations under "
+                         "jax.profiler.trace with device time joined back "
+                         "onto the declared plan-graph nodes "
+                         "(obs/profile.py) — per-stage ms, exchange-vs-"
+                         "compute split, per-stage roofline gap")
     ap.add_argument("--obs", action="store_true",
                     help="observability console: print wisdom-provenance "
                          "one-liners (hit|miss|migrated) as they happen and "
@@ -331,6 +338,7 @@ def run_testcase(plan, args, dims=None) -> int:
         print(f"Run complete: {result['mean_ms']:.4f} ms "
               f"(mean over {args.iterations} iterations)")
     print_obs_snapshot(args)
+    print_stage_profile(plan, args, dims=dims)
     return 0
 
 
@@ -343,6 +351,25 @@ def setup_obs(args) -> None:
         obs.enable(args.obs_dir)
     if getattr(args, "obs", False):
         obs.enable_console()
+
+
+def print_stage_profile(plan, args, dims=None) -> None:
+    """The ``--profile-stages`` epilogue (shared by all four CLIs): a
+    short measured window of the forward plan under ``jax.profiler``,
+    printed as device time per declared plan-graph node
+    (``obs/profile.py``). Best-effort — a profile failure must never
+    fail a run that already printed its result."""
+    if not getattr(args, "profile_stages", False):
+        return
+    from ..obs import profile as prof_mod
+    print("stage profile (measured device time per declared plan-graph "
+          "node):")
+    try:
+        prof = prof_mod.stage_profile(plan, "forward",
+                                      3 if dims is None else dims)
+        print("\n".join(prof_mod.format_stage_profile(prof)))
+    except Exception as e:  # noqa: BLE001 — epilogue is best-effort
+        print(f"  unavailable: {type(e).__name__}: {e}")
 
 
 def print_obs_snapshot(args) -> None:

@@ -24,7 +24,7 @@ import argparse
 import sys
 
 from .common import (add_common_args, maybe_profile, print_obs_snapshot,
-                     setup_backend)
+                     print_stage_profile, setup_backend)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -143,6 +143,10 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
                                      backend=backend, settings=settings)
         print(f"Run complete: {ms:.4f} ms (single-device 3D R2C, "
               f"{shape[0]}x{shape[1]}x{shape[2]})")
+        if getattr(args, "profile_stages", False):
+            print("stage profile: needs a declared plan graph — the "
+                  "single-device baseline has none (use testcase 4 or a "
+                  "decomposition executable)")
         return 0
 
     p = len(jax.devices())
@@ -157,6 +161,10 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
               f"[{kind}, {geometry}, {p} devices, "
               f"{r['bytes'] / 1e6:.1f} MB moved in {r['seconds'] * 1e3:.3f} ms, "
               f"collectives={r['collective_ops']}]")
+        if getattr(args, "profile_stages", False):
+            print("stage profile: needs a declared plan graph — the "
+                  "geometry probes have none (use testcase 4 or a "
+                  "decomposition executable)")
         return 0
     if args.testcase == 4:
         import numpy as np
@@ -201,6 +209,7 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
               f"pipeline {r['pipe_gb_per_s']:.3f} GB/s vs ceiling "
               f"{r['raw_gb_per_s']:.3f} GB/s, k={r['k']}, "
               f"{p} devices]")
+        print_stage_profile(plan, args)
         return 0
     print(f"unknown testcase {args.testcase}", file=sys.stderr)
     return 2

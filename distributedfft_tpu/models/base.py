@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import obs
 from ..ops import fft as local_fft
 from ..params import Config, GlobalSize, Partition
 from ..resilience import fallback, guards
@@ -293,6 +294,12 @@ class DistFFTPlan:
                              f"{nx}")
         return ck
 
+    def _scope_family(self) -> str:
+        """The plan-graph family key stage scopes are named under
+        (``dfft/<family>/<node-id>``; ``obs/profile.py``)."""
+        from ..analysis import contracts
+        return contracts.scope_family(self)
+
     def _fft3d_r2c(self, jit: bool = True) -> Any:
         norm, be = self.config.norm, self.config.fft_backend
         st = self._mxu_st
@@ -320,6 +327,7 @@ class DistFFTPlan:
             return local_fft.fft(c, axis=-3, norm=norm, backend=be,
                                  settings=st)
 
+        run = obs.profile.scoped(self._scope_family(), "local_fft:1", run)
         return self._jit_guarded(run, "forward") if jit else run
 
     def _fft3d_c2r(self, jit: bool = True) -> Any:
@@ -346,6 +354,7 @@ class DistFFTPlan:
             ys = jnp.reshape(c, (ck, nx // ck) + c.shape[1:])
             return jnp.reshape(jax.lax.map(per, ys), (nx,) + shape[1:])
 
+        run = obs.profile.scoped(self._scope_family(), "local_fft:1", run)
         return self._jit_guarded(run, "inverse") if jit else run
 
     def _fft3d_c2c(self, forward: bool, jit: bool = True) -> Any:
@@ -360,6 +369,7 @@ class DistFFTPlan:
                 return local_fft.fftn(c, axes, norm=norm, backend=be, settings=st)
             return local_fft.ifftn(c, axes, norm=norm, backend=be, settings=st)
 
+        run = obs.profile.scoped(self._scope_family(), "local_fft:1", run)
         if not jit:
             return run
         return self._jit_guarded(run, "forward" if forward else "inverse")

@@ -348,7 +348,11 @@ class Batched2DFFTPlan:
         """(pure_fn, in_spec, out_spec) — the specs travel with the
         composition so the jit wrapper cannot drift from the shard_map."""
         if self.fft3d or self.shard == "batch":
-            fn = self._chunked(lambda x: self._fft2(x, forward))
+            # Stage scope (obs/profile.py): the collective-free graph's
+            # one local_fft node covers the whole per-plane 2D transform.
+            fn = self._chunked(obs.profile.scoped(
+                "batched2d", "local_fft:1",
+                lambda x: self._fft2(x, forward)))
             if self.mesh is None:
                 return fn, PartitionSpec(), PartitionSpec()
             return (jax.shard_map(fn, mesh=self.mesh, in_specs=self._in_spec,
@@ -417,7 +421,11 @@ class Batched2DFFTPlan:
                                    settings=st)
                 return lf.irfft(c, n=ny, axis=2, norm=norm, backend=be,
                                 settings=st)
-        return first, xpose, last
+        # Stage scopes (obs/profile.py): the shard='x' graph's nodes.
+        sc = obs.profile.scoped
+        return (sc("batched2d", "local_fft:1", first),
+                sc("batched2d", "exchange:1", xpose),
+                sc("batched2d", "local_fft:2", last))
 
     def _build_slab_pure(self, forward: bool):
         """shard='x': 1D FFT y -> transpose (x-split -> y-split) -> 1D FFT x,
@@ -456,11 +464,13 @@ class Batched2DFFTPlan:
             enc_fn, arr_fn = plf.fused_ring_hooks(self.config)
 
             def rbody(v):
-                return last(ring_transpose(first(v), SLAB_AXIS, split,
-                                           concat, wire=wire,
-                                           overlap=overlap,
-                                           encode_fn=enc_fn,
-                                           arrive_fn=arr_fn))
+                with obs.profile.stage_scope("batched2d", "exchange:1"):
+                    y = ring_transpose(first(v), SLAB_AXIS, split,
+                                       concat, wire=wire,
+                                       overlap=overlap,
+                                       encode_fn=enc_fn,
+                                       arrive_fn=arr_fn)
+                return last(y)
 
             return (jax.shard_map(rbody, mesh=mesh, in_specs=in_spec,
                                   out_specs=out_spec),
@@ -493,7 +503,9 @@ class Batched2DFFTPlan:
             ca = shift
 
             def pure(v):
-                return stage2(chunked_reshard(stage1(v), boundary, ca, k))
+                with obs.profile.stage_scope("batched2d", "exchange:1"):
+                    y = chunked_reshard(stage1(v), boundary, ca, k)
+                return stage2(y)
 
             return pure, in_spec, out_spec
         return (lambda v: stage2(stage1(v)), in_spec, out_spec)

@@ -369,6 +369,43 @@ class PencilFFTPlan(DistFFTPlan):
 
     # -- pipeline bodies ---------------------------------------------------
 
+    def _scope_ids(self, direction: str, dims: int) -> Dict[str, str]:
+        """Plan-graph node ids per pipeline part (obs/profile.py stage
+        scopes) — mirrors ``_declare_graph``'s per-kind numbering exactly:
+        local-FFT stages count in pipeline order, exchanges count only
+        when their mesh axis is > 1 (the graph declares none otherwise)."""
+        ids: Dict[str, str] = {}
+        lf_n = x_n = 0
+
+        def nlf(part: str) -> None:
+            nonlocal lf_n
+            lf_n += 1
+            ids[part] = f"local_fft:{lf_n}"
+
+        def nx_(part: str, p: int) -> None:
+            nonlocal x_n
+            if p > 1:
+                x_n += 1
+                ids[part] = f"exchange:{x_n}"
+
+        if direction == "forward":
+            nlf("s1")
+            if dims >= 2:
+                nx_("t1", self.p2)
+                nlf("s2")
+            if dims >= 3:
+                nx_("t2", self.p1)
+                nlf("s3")
+        else:
+            if dims >= 3:
+                nlf("i3")
+                nx_("t2b", self.p1)
+            if dims >= 2:
+                nlf("i2")
+                nx_("t1b", self.p2)
+            nlf("i1")
+        return ids
+
     def _fwd_parts(self, dims: int):
         """(s1, t1, s2, t2, s3): local-FFT bodies and transpose bodies for
         the forward pipeline at depth ``dims``; t's are None when the
@@ -410,8 +447,14 @@ class PencilFFTPlan(DistFFTPlan):
             c = slice_axis_to(cl, 0, nx)
             return lf.fft(c, axis=0, norm=norm, backend=be, settings=st)
 
-        return (s1, t1 if dims >= 2 else None, s2,
-                t2 if dims >= 3 else None, s3)
+        # Stage scopes (obs/profile.py): graph node ids per part.
+        ids = self._scope_ids("forward", dims)
+        sc = obs.profile.scoped
+        return (sc("pencil", ids["s1"], s1),
+                sc("pencil", ids.get("t1", ""), t1) if dims >= 2 else None,
+                sc("pencil", ids.get("s2", "local_fft:2"), s2),
+                sc("pencil", ids.get("t2", ""), t2) if dims >= 3 else None,
+                sc("pencil", ids.get("s3", "local_fft:3"), s3))
 
     def _inv_parts(self, dims: int):
         """(i3, t2b, i2, t1b, i1): inverse bodies mirroring ``_fwd_parts``."""
@@ -447,8 +490,14 @@ class PencilFFTPlan(DistFFTPlan):
                 return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
             return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be, settings=st)
 
-        return (i3 if dims >= 3 else None, t2b if dims >= 3 else None,
-                i2 if dims >= 2 else None, t1b if dims >= 2 else None, i1)
+        # Stage scopes (obs/profile.py): inverse graph node numbering.
+        ids = self._scope_ids("inverse", dims)
+        sc = obs.profile.scoped
+        return (sc("pencil", ids.get("i3", ""), i3) if dims >= 3 else None,
+                sc("pencil", ids.get("t2b", ""), t2b) if dims >= 3 else None,
+                sc("pencil", ids.get("i2", ""), i2) if dims >= 2 else None,
+                sc("pencil", ids.get("t1b", ""), t1b) if dims >= 2 else None,
+                sc("pencil", ids["i1"], i1))
 
     # -- pipeline builders -------------------------------------------------
 
@@ -463,18 +512,21 @@ class PencilFFTPlan(DistFFTPlan):
         rendering of the reference's per-transpose Streams engine
         (``src/pencil/mpicufft_pencil.cpp:678-1482`` send methods)."""
         s1, t1, s2, t2, s3 = self._fwd_parts(dims)
+        ids = self._scope_ids("forward", dims)
         segments = [(s1, self._in_spec)]
         if dims >= 2:
             if not self._attach(segments, self.config.comm_method,
                                 self.config.send_method, t1, s2,
                                 self._mid_spec, ca=0,
-                                xinfo=(P2_AXIS, 2, 1)):
+                                xinfo=(P2_AXIS, 2, 1),
+                                scope_id=ids.get("t1", "")):
                 segments.append((s2, self._mid_spec))
         if dims >= 3:
             if not self._attach(segments, self.config.resolved_comm2(),
                                 self.config.resolved_snd2(), t2, s3,
                                 self._out_spec, ca=2,
-                                xinfo=(P1_AXIS, 1, 0)):
+                                xinfo=(P1_AXIS, 1, 0),
+                                scope_id=ids.get("t2", "")):
                 segments.append((s3, self._out_spec))
         return segments, self._in_spec
 
@@ -482,13 +534,15 @@ class PencilFFTPlan(DistFFTPlan):
         """(segments, start_spec) of the inverse pipeline (free axes mirror
         the forward: t2b moves x<->y, free z; t1b moves y<->z, free x)."""
         i3, t2b, i2, t1b, i1 = self._inv_parts(dims)
+        ids = self._scope_ids("inverse", dims)
         segments: List = []
         if dims >= 3:
             segments.append((i3, self._out_spec))
             if self._attach(segments, self.config.resolved_comm2(),
                             self.config.resolved_snd2(), t2b, i2,
                             self._mid_spec, ca=2,
-                            xinfo=(P1_AXIS, 0, 1)):
+                            xinfo=(P1_AXIS, 0, 1),
+                            scope_id=ids.get("t2b", "")):
                 i2 = None  # consumed into the chunked segment
         if dims >= 2:
             if i2 is not None:
@@ -496,7 +550,8 @@ class PencilFFTPlan(DistFFTPlan):
             if self._attach(segments, self.config.comm_method,
                             self.config.send_method, t1b, i1,
                             self._in_spec, ca=0,
-                            xinfo=(P2_AXIS, 1, 2)):
+                            xinfo=(P2_AXIS, 1, 2),
+                            scope_id=ids.get("t1b", "")):
                 i1 = None
         if i1 is not None:
             segments.append((i1, self._in_spec))
@@ -618,7 +673,7 @@ class PencilFFTPlan(DistFFTPlan):
 
     def _attach(self, segments, comm: pm.CommMethod, snd: pm.SendMethod,
                 a2a, nxt, spec_after, ca: int, *,
-                xinfo: Tuple[str, int, int]) -> bool:
+                xinfo: Tuple[str, int, int], scope_id: str = "") -> bool:
         """Attach a transpose to the segment list.
 
         ALL2ALL + SYNC: explicit collective fused into the previous segment.
@@ -655,9 +710,13 @@ class PencilFFTPlan(DistFFTPlan):
             enc_fn, arr_fn = plf.fused_ring_hooks(self.config, snd)
 
             def rseg(c, f=prev_fn):
-                return ring_transpose(f(c), axis_name, split, concat,
-                                      wire=wire, overlap=overlap,
-                                      encode_fn=enc_fn, arrive_fn=arr_fn)
+                # The ring is built here (not via the scoped a2a body), so
+                # the exchange scope wraps this call site directly.
+                with obs.profile.stage_scope("pencil", scope_id):
+                    return ring_transpose(f(c), axis_name, split, concat,
+                                          wire=wire, overlap=overlap,
+                                          encode_fn=enc_fn,
+                                          arrive_fn=arr_fn)
 
             segments[-1] = (rseg, spec_after)
             return False
@@ -826,6 +885,7 @@ class PencilFFTPlan(DistFFTPlan):
                 c = lf.fft(c, axis=0, norm=norm, backend=be, settings=st)
             return c
 
+        run = obs.profile.scoped("pencil", "local_fft:1", run)
         if not jit:
             return run
         from ..resilience import guards
@@ -847,6 +907,7 @@ class PencilFFTPlan(DistFFTPlan):
                 return lf.ifft(c, axis=2, norm=norm, backend=be, settings=st)
             return lf.irfft(c, n=nz, axis=2, norm=norm, backend=be, settings=st)
 
+        run = obs.profile.scoped("pencil", "local_fft:1", run)
         if not jit:
             return run
         from ..resilience import guards

@@ -343,8 +343,12 @@ class SlabFFTPlan(DistFFTPlan):
         ca = self._streams_chunk_axis()
 
         def one(cl, split, concat):
-            return all_to_all_transpose(cl, SLAB_AXIS, split, concat,
-                                        realigned=realigned, wire=wire)
+            # Stage scope (obs/profile.py): the whole monolithic exchange
+            # group — encode/collective/decode — attributes to the graph's
+            # exchange:1 node (the wire layer nests its own sub-scopes).
+            with obs.profile.stage_scope("slab", "exchange:1"):
+                return all_to_all_transpose(cl, SLAB_AXIS, split, concat,
+                                            realigned=realigned, wire=wire)
 
         if chunks is None or chunks <= 1:
             return (lambda cl: one(cl, sa, 0)), (lambda cl: one(cl, 0, sa))
@@ -383,7 +387,10 @@ class SlabFFTPlan(DistFFTPlan):
                 c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
             return c
 
-        return first, xpose, last
+        # Stage scopes: the graph's local_fft:1 / local_fft:2 nodes
+        # (metadata only — obs/profile.py attribution).
+        return (obs.profile.scoped("slab", "local_fft:1", first), xpose,
+                obs.profile.scoped("slab", "local_fft:2", last))
 
     def _inv_parts(self):
         s, norm, g = self._seq, self.config.norm, self.global_size
@@ -413,7 +420,10 @@ class SlabFFTPlan(DistFFTPlan):
             return lf.irfft(c, n=real_n, axis=s.r2c_axis, norm=norm,
                             backend=be, settings=st)
 
-        return first, xpose, last
+        # Inverse graph numbering: its stage 1 (post-axis inverses) is
+        # local_fft:1, its stage 2 (pre-axis + r2c inverses) local_fft:2.
+        return (obs.profile.scoped("slab", "local_fft:1", first), xpose,
+                obs.profile.scoped("slab", "local_fft:2", last))
 
     # -- STREAMS (chunked / software-pipelined) bodies ---------------------
     # The TPU rendering of the reference's Streams send engine (per-peer
@@ -451,13 +461,16 @@ class SlabFFTPlan(DistFFTPlan):
             outs = []
             for piece in split_axis_chunks(c, ca, k):
                 y = xpose(piece)
-                y = slice_axis_to(y, 0, nx)
-                for a in per_chunk:
-                    y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
+                with obs.profile.stage_scope("slab", "local_fft:2"):
+                    y = slice_axis_to(y, 0, nx)
+                    for a in per_chunk:
+                        y = lf.fft(y, axis=a, norm=norm, backend=be,
+                                   settings=st)
                 outs.append(y)
-            c = concat_axis_chunks(outs, ca)
-            for a in after:
-                c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
+            with obs.profile.stage_scope("slab", "local_fft:2"):
+                c = concat_axis_chunks(outs, ca)
+                for a in after:
+                    c = lf.fft(c, axis=a, norm=norm, backend=be, settings=st)
             return c
 
         return body
@@ -476,14 +489,18 @@ class SlabFFTPlan(DistFFTPlan):
 
         def body(cl):
             c = cl
-            for a in after:
-                c = lf.ifft(c, axis=a, norm=norm, backend=be, settings=st)
+            with obs.profile.stage_scope("slab", "local_fft:1"):
+                for a in after:
+                    c = lf.ifft(c, axis=a, norm=norm, backend=be,
+                                settings=st)
             outs = []
             for piece in split_axis_chunks(c, ca, k):
-                y = piece
-                for a in reversed(per_chunk):
-                    y = lf.ifft(y, axis=a, norm=norm, backend=be, settings=st)
-                y = pad_axis_to(y, 0, nx_pad)
+                with obs.profile.stage_scope("slab", "local_fft:1"):
+                    y = piece
+                    for a in reversed(per_chunk):
+                        y = lf.ifft(y, axis=a, norm=norm, backend=be,
+                                    settings=st)
+                    y = pad_axis_to(y, 0, nx_pad)
                 outs.append(xpose_inv(y))
             return last(concat_axis_chunks(outs, ca))
 
@@ -555,9 +572,13 @@ class SlabFFTPlan(DistFFTPlan):
         tf = lf.ifft if inverse else lf.fft
 
         def pipe(b):
-            for a in axes:
-                b = tf(b, axis=a, norm=norm, backend=be, settings=st)
-            return b
+            # The pipelined per-block FFTs belong to the graph's stage-2
+            # local-FFT node even though they trace inside the ring
+            # (innermost scope wins in attribution).
+            with obs.profile.stage_scope("slab", "local_fft:2"):
+                for a in axes:
+                    b = tf(b, axis=a, norm=norm, backend=be, settings=st)
+                return b
 
         return pipe
 
@@ -578,12 +599,15 @@ class SlabFFTPlan(DistFFTPlan):
         overlap = self._ring_overlap()
 
         def body(xl):
-            y = ring_transpose(first(xl), SLAB_AXIS, sa, 0, pipeline_fn=pipe,
-                               wire=wire, overlap=overlap,
-                               encode_fn=enc_fn, arrive_fn=arr_fn)
-            y = slice_axis_to(y, 0, nx)
-            for a in after:
-                y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
+            with obs.profile.stage_scope("slab", "exchange:1"):
+                y = ring_transpose(first(xl), SLAB_AXIS, sa, 0,
+                                   pipeline_fn=pipe, wire=wire,
+                                   overlap=overlap, encode_fn=enc_fn,
+                                   arrive_fn=arr_fn)
+            with obs.profile.stage_scope("slab", "local_fft:2"):
+                y = slice_axis_to(y, 0, nx)
+                for a in after:
+                    y = lf.fft(y, axis=a, norm=norm, backend=be, settings=st)
             return y
 
         return body
@@ -613,19 +637,23 @@ class SlabFFTPlan(DistFFTPlan):
         overlap = self._ring_overlap()
 
         def body(cl):
-            y = ring_transpose(first(cl), SLAB_AXIS, 0, sa, pipeline_fn=pipe,
-                               wire=wire, overlap=overlap,
-                               encode_fn=enc_fn, arrive_fn=arr_fn)
-            y = slice_axis_to(y, sa, split_ext)
-            for a in after:
-                y = lf.ifft(y, axis=a, norm=norm, backend=be, settings=st)
-            if complex_mode:
-                if s.r2c_axis == sa:
-                    y = lf.ifft(y, axis=s.r2c_axis, norm=norm, backend=be,
+            with obs.profile.stage_scope("slab", "exchange:1"):
+                y = ring_transpose(first(cl), SLAB_AXIS, 0, sa,
+                                   pipeline_fn=pipe, wire=wire,
+                                   overlap=overlap, encode_fn=enc_fn,
+                                   arrive_fn=arr_fn)
+            with obs.profile.stage_scope("slab", "local_fft:2"):
+                y = slice_axis_to(y, sa, split_ext)
+                for a in after:
+                    y = lf.ifft(y, axis=a, norm=norm, backend=be,
                                 settings=st)
-                return y
-            return lf.irfft(y, n=real_n, axis=s.r2c_axis, norm=norm,
-                            backend=be, settings=st)
+                if complex_mode:
+                    if s.r2c_axis == sa:
+                        y = lf.ifft(y, axis=s.r2c_axis, norm=norm,
+                                    backend=be, settings=st)
+                    return y
+                return lf.irfft(y, n=real_n, axis=s.r2c_axis, norm=norm,
+                                backend=be, settings=st)
 
         return body
 
@@ -734,7 +762,9 @@ class SlabFFTPlan(DistFFTPlan):
         ca = ca + shift
 
         def pure(x):
-            return stage2(chunked_reshard(stage1(x), boundary, ca, k))
+            with obs.profile.stage_scope("slab", "exchange:1"):
+                y = chunked_reshard(stage1(x), boundary, ca, k)
+            return stage2(y)
 
         return pure
 

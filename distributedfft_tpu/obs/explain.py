@@ -102,6 +102,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--obs-dir", default=None,
                     help="write the obs event log here (same as "
                          "$DFFT_OBS_DIR)")
+    ap.add_argument("--profile", action="store_true",
+                    help="measure a stage-attributed device profile: run "
+                         "the forward plan under jax.profiler.trace and "
+                         "join device time back onto the declared plan "
+                         "graph (obs/profile.py) — the ONE explain mode "
+                         "that executes the FFT")
+    ap.add_argument("--profile-iters", type=int, default=3,
+                    help="profiled iterations for --profile (default 3; "
+                         "one warmup run precedes the captured window)")
     return ap
 
 
@@ -665,6 +674,20 @@ def main(argv=None) -> int:
 
         out.append("roofline (evalkit/roofline.py):")
         out.extend(_roofline_lines(args, kind, cfg.fft_backend))
+
+        if args.profile:
+            out.append("stage profile (MEASURED — jax.profiler trace of "
+                       f"{max(1, args.profile_iters)} forward iteration(s), "
+                       "device time joined onto the declared graph):")
+            try:
+                from . import profile as prof_mod
+                with obs.span("explain.profile", kind=mk_kind):
+                    prof = prof_mod.stage_profile(
+                        plan, "forward", dims,
+                        iters=max(1, args.profile_iters))
+                out.extend(prof_mod.format_stage_profile(prof))
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                out.append(f"  unavailable: {type(e).__name__}: {e}")
 
         print("\n".join(out))
 

@@ -1,4 +1,4 @@
-"""Process-global named counters and gauges.
+"""Process-global named counters, gauges and latency histograms.
 
 The runtime's measured decisions (wisdom hits vs. races, wire-budget
 rejections, HLO collective census) previously left no machine-readable
@@ -7,10 +7,26 @@ active — incrementing a counter is a dict update under a lock, touches no
 jax state, and cannot perturb a compiled program — while the event log
 (``tracing.py``) stays opt-in.
 
-Consumers: ``bench.py`` folds ``snapshot()`` into ``BENCH_DETAILS.json``
-(per child process, keys ``obs_metrics_mesh`` / ``obs_metrics_tpu``), the
-CLIs print it under ``--obs``, and ``dfft-explain`` reports the census
-gauges its compile populates.
+TWO VIEWS, ONE STORE (the reset-semantics contract; ISSUE 12): counters
+and histograms accumulate monotonically for the whole process lifetime —
+``reset()`` never erases them. What ``reset()`` does is mark a **baseline**
+so the default ``snapshot()`` / ``counter_value()`` read the *per-plan*
+window (everything since the last ``reset()``), while
+``snapshot(view="cumulative")`` / ``counter_total()`` read the raw
+process totals. The split exists because the two consumers want opposite
+things and conflating them corrupted both: tests and ``bench.py`` want a
+clean per-plan window (reset between plans), while the Prometheus
+exposition (``promexp.py``) requires monotone counters — a scrape must
+NEVER see a counter go backwards, so ``/metrics`` always renders the
+cumulative view. Gauges hold the last value set and are cleared by
+``reset()`` (a gauge has no meaningful baseline). Every snapshot carries
+its ``"view"`` so a folded JSON artifact says which window it is.
+
+Histograms (``observe``): fixed-boundary latency histograms in
+milliseconds (cumulative bucket counts, Prometheus-shaped: ``le`` upper
+bounds plus +Inf, a running sum and count). The serving layer feeds
+``serve.queue_wait_ms`` / ``serve.exec_ms`` / ``serve.e2e_ms`` so the
+scrape surface carries distributions, not just the EMA.
 
 Metric names (the stable vocabulary; see README "Observability"):
 
@@ -48,6 +64,7 @@ inject.lock_contentions    counter simulated held-lock reads
 inject.cell_hangs          counter simulated hung race cells
 inject.server_slow         counter injected serve-path straggler delays
 wisdom.demotion_expired    counter demotion stamps aged out (TTL) on read
+flightrec.dumps            counter flight-recorder dumps written
 serve.requests             counter requests admitted to the queue
 serve.requests_served      counter requests answered with a result
 serve.batches              counter coalesced batch executions
@@ -67,28 +84,46 @@ serve.plan_cache.evictions counter LRU evictions
 serve.plan_cache.size      gauge   live plan-cache occupancy
 serve.queue_depth          gauge   admission queue depth after last change
 serve.ema_ms               gauge   per-request execution EMA (warm batches)
+serve.queue_wait_ms        histo   admission -> execution start, per request
+serve.exec_ms              histo   warm batch execution / batch size
+serve.e2e_ms               histo   admission -> reply, served requests only
 ========================== ======= ==========================================
-
-Counters accumulate until ``reset()`` (tests reset between plans); gauges
-hold the last value set.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Union
+from typing import Dict, List, Tuple, Union
 
 Number = Union[int, float]
 
 _LOCK = threading.Lock()
 _COUNTERS: Dict[str, Number] = {}
+_BASELINE: Dict[str, Number] = {}
 _GAUGES: Dict[str, Number] = {}
+
+# Histogram store: name -> [boundaries, bucket counts (+Inf last), sum,
+# count]; *_BASE mirrors counts/sum/count at the last reset().
+_HISTOS: Dict[str, list] = {}
+_HISTO_BASE: Dict[str, list] = {}
+
+# Default latency boundaries (ms): sub-ms warm hits through multi-second
+# cold compiles. A Prometheus histogram's +Inf bucket is implicit here
+# (the last slot of the counts list).
+DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000)
+
+VIEWS = ("plan", "cumulative")
 
 
 def inc(name: str, n: Number = 1) -> None:
-    """Add ``n`` to counter ``name`` (creating it at 0)."""
+    """Add ``n`` to counter ``name`` (creating it at 0). The delta also
+    lands in the flight-recorder ring (``obs/flightrec.py``), so a dump
+    shows which counters moved in the final seconds."""
     with _LOCK:
         _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+    from . import flightrec
+    flightrec.record("metric", name, delta=n)
 
 
 def gauge(name: str, value: Number) -> None:
@@ -97,7 +132,38 @@ def gauge(name: str, value: Number) -> None:
         _GAUGES[name] = value
 
 
+def observe(name: str, value_ms: Number,
+            buckets: Tuple[float, ...] = DEFAULT_BUCKETS_MS) -> None:
+    """Record one latency observation into histogram ``name``. The first
+    ``observe`` of a name fixes its boundaries; later calls ignore the
+    ``buckets`` argument (one histogram, one shape)."""
+    v = float(value_ms)
+    with _LOCK:
+        h = _HISTOS.get(name)
+        if h is None:
+            bounds = tuple(sorted(float(b) for b in buckets))
+            h = [bounds, [0] * (len(bounds) + 1), 0.0, 0]
+            _HISTOS[name] = h
+        bounds, counts = h[0], h[1]
+        i = len(bounds)
+        for j, b in enumerate(bounds):
+            if v <= b:
+                i = j
+                break
+        counts[i] += 1
+        h[2] += v
+        h[3] += 1
+
+
 def counter_value(name: str) -> Number:
+    """Per-plan view: the counter's growth since the last ``reset()``."""
+    with _LOCK:
+        return _COUNTERS.get(name, 0) - _BASELINE.get(name, 0)
+
+
+def counter_total(name: str) -> Number:
+    """Cumulative view: the raw process-lifetime total (what the
+    Prometheus exposition renders — monotone across ``reset()``)."""
     with _LOCK:
         return _COUNTERS.get(name, 0)
 
@@ -107,16 +173,76 @@ def gauge_value(name: str, default: Number = 0) -> Number:
         return _GAUGES.get(name, default)
 
 
-def snapshot() -> Dict[str, Dict[str, Number]]:
-    """Point-in-time copy: ``{"counters": {...}, "gauges": {...}}`` with
-    deterministically ordered keys (stable for JSON diffs)."""
+def _histo_view(name: str, cumulative: bool) -> Dict[str, object]:
+    """Caller holds the lock."""
+    bounds, counts, total, n = _HISTOS[name]
+    if not cumulative and name in _HISTO_BASE:
+        bcounts, bsum, bn = _HISTO_BASE[name]
+        counts = [c - b for c, b in zip(counts, bcounts)]
+        total, n = total - bsum, n - bn
+    else:
+        counts = list(counts)
+    return {"buckets": list(bounds), "counts": counts,
+            "sum": round(float(total), 4), "count": n}
+
+
+def snapshot(view: str = "plan") -> Dict[str, object]:
+    """Point-in-time copy with deterministically ordered keys (stable for
+    JSON diffs): ``{"view", "counters", "gauges", "histograms"}``.
+
+    ``view="plan"`` (default) is the since-last-``reset()`` window — what
+    ``bench.py`` folds per child and tests assert on. ``"cumulative"`` is
+    the monotone process totals — what ``/metrics`` scrapes. Zero-valued
+    per-plan counters are omitted (a counter untouched this plan is not
+    part of this plan's story); cumulative keeps every key ever touched.
+    """
+    if view not in VIEWS:
+        raise ValueError(f"view must be one of {VIEWS}, got {view!r}")
+    cumulative = view == "cumulative"
     with _LOCK:
-        return {"counters": {k: _COUNTERS[k] for k in sorted(_COUNTERS)},
-                "gauges": {k: _GAUGES[k] for k in sorted(_GAUGES)}}
+        if cumulative:
+            counters = {k: _COUNTERS[k] for k in sorted(_COUNTERS)}
+        else:
+            counters = {}
+            for k in sorted(_COUNTERS):
+                delta = _COUNTERS[k] - _BASELINE.get(k, 0)
+                if delta:
+                    counters[k] = delta
+        histos = {}
+        for k in sorted(_HISTOS):
+            h = _histo_view(k, cumulative)
+            if cumulative or h["count"]:
+                histos[k] = h
+        return {"view": view,
+                "counters": counters,
+                "gauges": {k: _GAUGES[k] for k in sorted(_GAUGES)},
+                "histograms": histos}
 
 
 def reset() -> None:
-    """Clear every counter and gauge (test isolation between plans)."""
+    """Start a new per-plan window: baseline the counters/histograms and
+    clear the gauges. The cumulative view (and therefore the Prometheus
+    exposition) is UNAFFECTED — counters stay monotone across plans."""
+    with _LOCK:
+        _BASELINE.clear()
+        _BASELINE.update(_COUNTERS)
+        for k, h in _HISTOS.items():
+            _HISTO_BASE[k] = [list(h[1]), h[2], h[3]]
+        _GAUGES.clear()
+
+
+def hard_reset() -> None:
+    """Erase EVERYTHING, both views (process-start state). Test isolation
+    between test files only — production code must use ``reset()``, which
+    keeps the scrape surface monotone."""
     with _LOCK:
         _COUNTERS.clear()
+        _BASELINE.clear()
         _GAUGES.clear()
+        _HISTOS.clear()
+        _HISTO_BASE.clear()
+
+
+def histogram_names() -> List[str]:
+    with _LOCK:
+        return sorted(_HISTOS)

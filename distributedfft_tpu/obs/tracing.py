@@ -15,14 +15,19 @@ them: a nestable ``span("plan.build")`` context manager that
   the same names appear on the TensorBoard / Perfetto timeline next to the
   device ops they schedule.
 
-ZERO-OVERHEAD-WHEN-OFF CONTRACT: with no ``$DFFT_OBS_DIR`` (and no
-programmatic ``enable()``), ``span()`` returns a shared no-op context
-manager and nothing else happens — no file I/O, no jax import, no
-annotation, and (pinned by ``tests/test_obs.py``) no change to any compiled
-HLO. Spans never appear *inside* jitted programs as ops: they are host-side
+ZERO-OVERHEAD-WHEN-OFF CONTRACT (amended by the flight recorder,
+ISSUE 12): with no ``$DFFT_OBS_DIR`` (and no programmatic ``enable()``)
+there is still **no file I/O, no jax import and no profiler annotation**
+— but spans/events/notices are no longer dropped entirely: every record
+is appended to the always-on in-memory flight-recorder ring
+(``obs/flightrec.py``; a dict build and a bounded deque append), so a
+trigger can dump the last seconds of evidence even from a run that never
+enabled the log. ``$DFFT_FLIGHTREC=off`` restores the full drop. Spans
+never appear *inside* jitted programs as ops: they are host-side
 intervals around plan construction, autotuning, wisdom I/O and trace-time
-program building, which is also why enabling the log cannot perturb the
-compiled program (the same test pins enabled == disabled HLO byte-for-byte).
+program building, which is why neither the ring nor the log can perturb
+the compiled program (``tests/test_obs.py`` pins enabled == disabled HLO
+byte-for-byte).
 
 Everything here degrades rather than errors: an unwritable log directory
 silently drops events (observability must never fail a run).
@@ -144,6 +149,10 @@ def _scalar(v):
 
 
 def _emit(rec: Dict[str, Any]) -> None:
+    """One finished record: always into the flight-recorder ring (bounded,
+    in-memory), and into the JSONL log file only when tracing is on."""
+    from . import flightrec
+    flightrec.add(rec)
     path = event_log_path()
     if path is None:
         return
@@ -197,6 +206,10 @@ class _Span:
         # Device-trace annotation: inside a jax.profiler trace the span name
         # shows on the TensorBoard/Perfetto timeline; outside one this is a
         # cheap no-op, and on a jax-free interpreter it is skipped entirely.
+        # Ring-only spans (log off) skip it — the off path imports no jax.
+        if not enabled():
+            self._ann = None
+            return self
         try:
             import jax
             self._ann = jax.profiler.TraceAnnotation(f"dfft:{self.name}")
@@ -221,30 +234,41 @@ class _Span:
         return False
 
 
+def _recording() -> bool:
+    """Whether anything downstream wants records: the JSONL log (opt-in)
+    or the always-on flight-recorder ring."""
+    if enabled():
+        return True
+    from . import flightrec
+    return flightrec.enabled()
+
+
 def span(name: str, **attrs):
     """Nestable tracing span. ``with span("plan.build", kind="slab"): ...``
-    records a JSONL span event (and a profiler TraceAnnotation) when
-    observability is on; when off it returns a shared no-op."""
-    if not enabled():
+    records a span record into the flight-recorder ring (always) and the
+    JSONL event log (when on). Only with ``$DFFT_FLIGHTREC=off`` AND the
+    log off does it degrade to the shared no-op."""
+    if not _recording():
         return _NULL
     return _Span(name, attrs)
 
 
 def event(name: str, **attrs) -> None:
-    """One-shot point event (no duration) into the event log."""
-    if not enabled():
+    """One-shot point event (no duration): flight-recorder ring always,
+    event log when on."""
+    if not _recording():
         return
     _emit(_base("event", name, attrs))
 
 
 def notice(msg: str, *, name: str = "notice", **attrs) -> None:
     """A human-readable one-liner: printed to stdout under the CLI
-    ``--obs`` flag, and recorded as an event when the log is on. Used for
-    wisdom provenance (``hit | miss | migrated(v1→v3)``) so the previously
-    silent resolution is visible."""
+    ``--obs`` flag, recorded into the ring always and the event log when
+    on. Used for wisdom provenance (``hit | miss | migrated(v1→v3)``) so
+    the previously silent resolution is visible."""
     if _CONSOLE:
         print(msg, flush=True)
-    if enabled():
+    if _recording():
         a = dict(attrs)
         a["msg"] = msg
         _emit(_base("event", name, a))

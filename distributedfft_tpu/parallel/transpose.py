@@ -66,10 +66,12 @@ def _wire_active(x, wire: str) -> bool:
 def wire_encode(x, wire: str = WIRE_BF16):
     """Complex array -> planar (real, imag) bf16 pair along a NEW leading
     axis (shape ``(2,) + x.shape``). Non-complex input and ``wire="native"``
-    pass through unchanged."""
+    pass through unchanged. The emitted ops carry the ``dfft/wire/encode``
+    stage scope (metadata only — ``obs/profile.py`` attribution)."""
     if not _wire_active(x, wire):
         return x
-    with obs.span("exchange.encode", wire=wire):
+    with obs.span("exchange.encode", wire=wire), \
+            obs.profile.wire_scope("encode"):
         return jnp.stack([jnp.real(x), jnp.imag(x)]).astype(jnp.bfloat16)
 
 
@@ -80,7 +82,8 @@ def wire_decode(y, dtype, wire: str = WIRE_BF16):
     validate_wire(wire)
     if wire == WIRE_NATIVE:
         return y
-    with obs.span("exchange.decode", wire=wire):
+    with obs.span("exchange.decode", wire=wire), \
+            obs.profile.wire_scope("decode"):
         f = (jnp.float64 if np.dtype(dtype) == np.complex128
              else jnp.float32)
         z = y.astype(f)
@@ -381,7 +384,11 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
         perm = [(src, (src + t) % p) for src in range(p)]
         b = chunk(t)
         if wired:
-            b = wire_encode(b, wire) if encode_fn is None else encode_fn(b)
+            if encode_fn is None:
+                b = wire_encode(b, wire)  # carries the wire/encode scope
+            else:
+                with obs.profile.wire_scope("encode"):
+                    b = encode_fn(b)
         # Fault-injection hook on each TRAVELLING block (the local block
         # never touches the wire, mirroring the encoding contract above);
         # identity without $DFFT_FAULT_SPEC.
@@ -390,9 +397,14 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
 
     def arrive(b):
         """Decode + per-block pipeline of one ARRIVED block (the receive
-        side of a ring step); ``arrive_fn`` fuses the pair."""
+        side of a ring step); ``arrive_fn`` fuses the pair. The fused
+        hook traces under the wire/decode scope (a family's arrive may
+        nest its pipelined-FFT stage scope inside — innermost wins in
+        attribution, so the fused DFT still lands on its local_fft
+        node)."""
         if arrive_fn is not None:
-            return arrive_fn(b)
+            with obs.profile.wire_scope("decode"):
+                return arrive_fn(b)
         if wired:
             b = wire_decode(b, x.dtype, wire)
         return pipeline_fn(b)

@@ -171,6 +171,11 @@ def _note_demotion(plan, rung: str, label: str, reason: str) -> None:
         f"-> {label} ({reason})",
         name="fallback.demotion", rung=rung, to=label, reason=reason,
         plan=fp["plan"], shape=fp["shape"], ranks=fp["ranks"])
+    # Flight-recorder trigger (ISSUE 12): a rung walk means the shipped
+    # rendering failed in production — dump the evidence leading up.
+    obs.flightrec.trigger("fallback_demotion",
+                          f"rung {rung} -> {label}: {reason}"[:200],
+                          rung=rung, plan=fp["plan"], shape=fp["shape"])
     _stamp_wisdom(plan, rung, reason)
 
 
@@ -202,6 +207,10 @@ def demote_wire(plan, reason: str) -> None:
         f"{plan.config.wire_dtype} -> native ({reason})",
         name="fallback.demotion", rung=RUNG_WIRE, to="native",
         reason=reason, plan=fp["plan"], shape=fp["shape"])
+    obs.flightrec.trigger("fallback_demotion",
+                          f"wire -> native: {reason}"[:200],
+                          rung=RUNG_WIRE, plan=fp["plan"],
+                          shape=fp["shape"])
     _stamp_wisdom(plan, RUNG_WIRE, reason)
     apply_config(plan, dataclasses.replace(plan.config,
                                            wire_dtype="native"))

@@ -170,18 +170,26 @@ def _energy(v, halved_axis: Optional[int], halved_n: int):
     return jnp.sum(a2)
 
 
-def wrap(pure, spec: GuardSpec, wire: str, probe: bool):
+def wrap(pure, spec: GuardSpec, wire: str, probe: bool,
+         family: str = "plan"):
     """The guarded pipeline: ``x -> (y, stats)`` where ``stats`` is a
     float32 2-vector ``[check_residual, wire_drift]`` (drift -1 when not
     probed). All guard ops are global-view inside the same jit as the
     pipeline, so GSPMD shards the elementwise work and folds the scalar
-    all-reduce into the reduction."""
+    all-reduce into the reduction. The guard reductions trace under the
+    ``dfft/<family>/guard`` stage scope (metadata only — the graph's
+    guard node in ``obs/profile.py`` attribution)."""
     import jax.numpy as jnp
 
+    from .. import obs
     from ..parallel.transpose import wire_decode, wire_encode
 
     def run(x):
         y = pure(x)
+        with obs.profile.stage_scope(family, "guard"):
+            return y, _stats(x, y)
+
+    def _stats(x, y):
         if spec.check == "finite":
             e = jnp.sum(jnp.real(y) ** 2 + jnp.imag(y) ** 2
                         if jnp.iscomplexobj(y) else y * y)
@@ -202,9 +210,8 @@ def wrap(pure, spec: GuardSpec, wire: str, probe: bool):
                 jnp.max(jnp.abs(v)), _TINY)
         else:
             drift = jnp.asarray(-1.0)
-        stats = jnp.stack([resid.astype(jnp.float32),
-                           drift.astype(jnp.float32)])
-        return y, stats
+        return jnp.stack([resid.astype(jnp.float32),
+                          drift.astype(jnp.float32)])
 
     return run
 
@@ -227,7 +234,9 @@ def maybe_wrap(plan, pure, direction: str, dims: int = 3):
         wire_budget=cfg.resolved_wire_budget(),
         probe=probe)
     plan._guard_state[(direction, dims)] = state
-    return wrap(pure, spec, wire, probe), True
+    from ..analysis import contracts
+    return wrap(pure, spec, wire, probe,
+                family=contracts.scope_family(plan)), True
 
 
 def fingerprint(plan, direction: str) -> dict:
@@ -289,6 +298,14 @@ def finish(plan, out, direction: str, dims: int = 3):
             tolerance=tol, mode=mode, **{k: v for k, v in fp.items()})
     if mode == "enforce":
         check, value, tol = violations[0]
+        # Flight-recorder trigger (ISSUE 12): dump the last seconds of
+        # spans/events/metric deltas BEFORE the violation propagates —
+        # the post-mortem evidence the counters alone cannot give.
+        from ..obs import flightrec
+        flightrec.trigger("guard_violation",
+                          f"{check} residual {value:.3e} > {tol:.3e}",
+                          check=check, value=value, tolerance=tol,
+                          plan=fp.get("plan"), shape=fp.get("shape"))
         raise GuardViolation(check, value, tol, fp)
     # check mode: a compressed wire implicated in a violation falls back
     # to native for subsequent calls (the issue's graceful-degradation

@@ -170,6 +170,16 @@ def _make_http(server, port: int):
                 ready = server.state == "running"
                 self._json(200 if ready else 503,
                            {"ready": ready, "state": server.state})
+            elif self.path == "/metrics":
+                # Prometheus exposition of the CUMULATIVE metrics view
+                # (obs/promexp.py) — the autoscaling scrape surface.
+                from ..obs import promexp
+                body = promexp.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", promexp.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": "unknown path"})
 
@@ -177,6 +187,7 @@ def _make_http(server, port: int):
             if self.path != "/fft":
                 self._json(404, {"error": "unknown path"})
                 return
+            trace_id = None
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 x = np.load(io.BytesIO(self.rfile.read(n)),
@@ -185,10 +196,16 @@ def _make_http(server, port: int):
                 direction = self.headers.get("X-DFFT-Direction", "forward")
                 ny = self.headers.get("X-DFFT-Ny")
                 ddl = self.headers.get("X-DFFT-Deadline-Ms")
-                out = server.request(
+                fut = server.submit(
                     x, transform, direction,
                     ny=int(ny) if ny else None,
                     deadline_ms=float(ddl) if ddl else None)
+                # The admission trace id: one request's whole path
+                # (admit -> coalesce -> execute -> reply) is
+                # reconstructable from the event log by this id, and the
+                # client gets it back as X-DFFT-Trace.
+                trace_id = getattr(fut, "trace_id", None)
+                out = fut.result()
             except Overloaded as e:
                 self._json(429, {"error": "overloaded", "reason": e.reason,
                                  "queue_depth": e.queue_depth,
@@ -215,6 +232,8 @@ def _make_http(server, port: int):
                 self.send_header("Content-Type",
                                  "application/octet-stream")
                 self.send_header("Content-Length", str(len(body)))
+                if trace_id:
+                    self.send_header("X-DFFT-Trace", trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -264,6 +283,10 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
+    # SIGUSR2 -> flight-recorder dump (live debugging: kill -USR2 <pid>
+    # dumps the last seconds of spans/events/metric deltas to JSONL; the
+    # path lands in health()["flight_recorder"]["last_dump"]).
+    obs.flightrec.install_signal_handler()
 
     rc = 0
     summary = None

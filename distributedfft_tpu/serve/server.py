@@ -39,7 +39,9 @@ an explicit robustness envelope. The request path:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -89,12 +91,31 @@ class _Request:
     deadline: Optional[Deadline]
     future: Future
     submitted_at: float
+    trace_id: str = ""
 
     def coalesce_key(self) -> Tuple[str, str]:
         return (self.base_key, self.direction)
 
 
 _EMA_ALPHA = 0.2
+
+# Per-process trace-id counter: ids are ``<pid hex>-<seq hex>`` — unique
+# within a fleet (pid disambiguates workers) and cheap (no uuid entropy
+# on the admission path).
+_TRACE_SEQ = [0]
+_TRACE_LOCK = threading.Lock()
+
+# Shed-burst detection window for the flight-recorder trigger: this many
+# sheds inside SHED_BURST_WINDOW_S seconds dump the ring once per
+# cooldown ($DFFT_FLIGHTREC_SHED_BURST overrides the count).
+SHED_BURST_WINDOW_S = 2.0
+SHED_BURST_DEFAULT = 10
+
+
+def _new_trace_id() -> str:
+    with _TRACE_LOCK:
+        _TRACE_SEQ[0] += 1
+        return f"{os.getpid():x}-{_TRACE_SEQ[0]:06x}"
 
 
 class Server:
@@ -146,6 +167,7 @@ class Server:
                         "deadline_expired": 0, "batches": 0,
                         "batch_failures": 0, "coalesced": 0}
         self._inflight = 0
+        self._shed_times: collections.deque = collections.deque()
         obs.event("serve.start", server=name, shard=shard,
                   ranks=self.partition.num_ranks, max_queue=max_queue,
                   latency_budget_ms=latency_budget_ms,
@@ -229,6 +251,26 @@ class Server:
         obs.event("serve.shed", reason=reason, queue_depth=depth,
                   est_delay_ms=round(est_ms, 2),
                   budget_ms=round(budget_ms, 2))
+        # Shed-burst flight-recorder trigger: a sustained rejection storm
+        # dumps the ring once per cooldown window — "here is the queue /
+        # EMA / circuit state of the seconds that led to it".
+        now = time.monotonic()
+        self._shed_times.append(now)
+        while self._shed_times and now - self._shed_times[0] \
+                > SHED_BURST_WINDOW_S:
+            self._shed_times.popleft()
+        try:
+            burst = int(os.environ.get("DFFT_FLIGHTREC_SHED_BURST",
+                                       str(SHED_BURST_DEFAULT)))
+        except ValueError:
+            burst = SHED_BURST_DEFAULT
+        if burst > 0 and len(self._shed_times) >= burst:
+            from ..obs import flightrec
+            flightrec.trigger(
+                "shed_burst",
+                f"{len(self._shed_times)} sheds in "
+                f"{SHED_BURST_WINDOW_S:.0f}s (last: {reason})",
+                queue_depth=depth, budget_ms=budget_ms)
         return Overloaded(reason, depth, est_ms, budget_ms)
 
     def submit(self, x: Any, transform: str = "r2c",
@@ -269,14 +311,21 @@ class Server:
                 raise self._shed("deadline", depth, est_ms,
                                  deadline.remaining_ms())
             fut: Future = Future()
+            tid = _new_trace_id()
             req = _Request(x=x, nx=nx, ny=ny_, transform=transform,
                            double=double, direction=direction,
                            base_key=key, deadline=deadline, future=fut,
-                           submitted_at=time.monotonic())
+                           submitted_at=time.monotonic(), trace_id=tid)
+            # The id rides the future so callers (the HTTP front end's
+            # X-DFFT-Trace header) can hand it back to the client.
+            fut.trace_id = tid  # type: ignore[attr-defined]
             self._pending.append(req)
             self._counts["admitted"] += 1
             obs.metrics.inc("serve.requests")
             obs.metrics.gauge("serve.queue_depth", len(self._pending))
+            obs.event("serve.admit", trace=tid, key=key,
+                      direction=direction,
+                      queue_depth=len(self._pending))
             self._cv.notify()
             return fut
 
@@ -307,6 +356,8 @@ class Server:
             self._pending = keep
         obs.metrics.gauge("serve.queue_depth", len(self._pending))
         self._inflight = len(batch)
+        obs.event("serve.coalesce", key=head.base_key, n=len(batch),
+                  traces=[r.trace_id for r in batch])
         return batch
 
     def _run(self) -> None:
@@ -341,7 +392,9 @@ class Server:
         obs.metrics.inc("serve.deadline_expired")
         over = -req.deadline.remaining_ms() if req.deadline else 0.0
         obs.event("serve.deadline_expired", key=req.base_key, detail=detail,
-                  overrun_ms=round(over, 2))
+                  overrun_ms=round(over, 2), trace=req.trace_id)
+        obs.event("serve.reply", trace=req.trace_id,
+                  outcome="deadline_expired")
         req.future.set_exception(DeadlineExceeded(
             f"deadline exceeded by {over:.1f} ms ({detail})",
             detail=detail, overrun_ms=over))
@@ -451,6 +504,13 @@ class Server:
             # one) must be released without a verdict about the plan.
             breaker.release()
             return
+        # Queue-wait distribution (admission -> execution start), per
+        # surviving request — the histogram the /metrics scrape exposes
+        # next to the EMA the shedder estimates from.
+        now_mono = time.monotonic()
+        for r in alive:
+            obs.metrics.observe("serve.queue_wait_ms",
+                                (now_mono - r.submitted_at) * 1e3)
         t0 = time.perf_counter()
         try:
             n = len(alive)
@@ -475,7 +535,9 @@ class Server:
                                key=lambda d: d.expires_at)
             head = alive[0]
             with obs.span("serve.execute", key=ckey, n=n, bucket=bucket,
-                          direction=head.direction), dl.scope(batch_dl):
+                          direction=head.direction,
+                          traces=[r.trace_id for r in alive]), \
+                    dl.scope(batch_dl):
                 if head.direction == "forward":
                     out = plan.exec_forward(stack)
                 else:
@@ -485,16 +547,30 @@ class Server:
             opened = breaker.record_failure(err)
             if opened:
                 self.cache.invalidate_prefix(key)
+                # Circuit-open flight-recorder trigger: the dump carries
+                # the admissions, batch events and metric deltas that
+                # led to the K-th consecutive failure.
+                from ..obs import flightrec
+                flightrec.trigger(
+                    "circuit_open", f"{type(err).__name__}: {err}"[:200],
+                    key=key)
             with self._lock:
                 self._counts["batch_failures"] += 1
             obs.metrics.inc("serve.batch_failures")
             obs.event("serve.batch_failed", key=key, n=len(alive),
                       error=f"{type(err).__name__}: {err}"[:300])
             for r in alive:
+                obs.event("serve.reply", trace=r.trace_id,
+                          outcome="error", error=type(err).__name__)
                 r.future.set_exception(err)
             return
         ms = (time.perf_counter() - t0) * 1e3
         breaker.record_success()
+        if hit:
+            # Warm (cache-hit) per-request execution distribution; cold
+            # batches are build-dominated and would swamp the histogram
+            # the same way they would corrupt the shed EMA.
+            obs.metrics.observe("serve.exec_ms", ms / n)
         if head.direction == "forward":
             res = res[:n, :head.nx, :plan._ny_spec]
         else:
@@ -520,12 +596,17 @@ class Server:
             obs.metrics.inc("serve.coalesced_requests", n)
         obs.event("serve.batch", key=ckey, n=n, bucket=bucket,
                   ms=round(ms, 3), cache_hit=hit)
+        done_mono = time.monotonic()
         for i, r in enumerate(alive):
             if r.deadline is not None and r.deadline.expired():
                 # The result exists but arrived too late: a deadline is a
                 # promise, and a late success is reported as expiry.
                 self._expire(r, "executing")
             else:
+                obs.metrics.observe("serve.e2e_ms",
+                                    (done_mono - r.submitted_at) * 1e3)
+                obs.event("serve.reply", trace=r.trace_id, outcome="ok",
+                          coalesced_n=n)
                 r.future.set_result(np.array(res[i]))
 
     # -- health / lifecycle ------------------------------------------------
@@ -557,6 +638,12 @@ class Server:
             }
         snap["plan_cache"] = self.cache.snapshot()
         snap["obs_metrics"] = obs.snapshot()
+        # Flight recorder (ISSUE 12): ring occupancy + the most recent
+        # triggered dump's path, so an operator reading /healthz knows
+        # where the post-mortem evidence landed.
+        from ..obs import flightrec
+        snap["flight_recorder"] = dict(flightrec.stats(),
+                                       last_dump=flightrec.last_dump())
         return snap
 
     @property

@@ -1,0 +1,212 @@
+"""Flight recorder (``obs/flightrec.py``) — ISSUE 12:
+
+* ring semantics: always-on bounded deque (oldest displaced, displacement
+  accounted), span/event/notice/metric records all land in it, capacity
+  follows ``$DFFT_FLIGHTREC_CAPACITY``, ``$DFFT_FLIGHTREC=off`` drops
+  everything;
+* trigger chain: a dump flushes the ring oldest-first to one JSONL file
+  whose header names the trigger; per-trigger cooldown rate-limits a
+  failure storm to one dump per window; an unwritable directory loses the
+  dump, never the run;
+* dump schema: ``validate_dump_file`` accepts every real dump and rejects
+  each defect class (missing header, unknown trigger, record-count
+  mismatch, malformed record) — the same checker the CI chaos job runs
+  over the uploaded artifact;
+* the END-TO-END trigger chain under an injected ``wire:bitflip``
+  (satellite 3): guards=enforce raises ``GuardViolation``, the recorder
+  dumps BEFORE the exception propagates, and the dump carries both the
+  violation evidence and the preceding build spans.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import obs
+from distributedfft_tpu import params as pm
+from distributedfft_tpu.obs import flightrec
+from distributedfft_tpu.resilience import GuardViolation, inject
+
+
+@pytest.fixture(autouse=True)
+def _flightrec_hygiene(monkeypatch, tmp_path):
+    """Clean ring, a writable dump dir, no cooldown carry-over, and no
+    fault/guard env around every test."""
+    for var in (inject.ENV_VAR, "DFFT_GUARDS", flightrec.ENV_OFF,
+                flightrec.ENV_CAPACITY, flightrec.ENV_COOLDOWN):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(flightrec.ENV_DIR, str(tmp_path))
+    flightrec.clear()
+    obs.reset()
+    yield
+    flightrec.clear()
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_ring_receives_spans_events_and_metric_deltas():
+    with obs.span("build.something", kind="t"):
+        obs.event("decision.made", choice=1)
+    obs.metrics.inc("wisdom.hits")
+    kinds = {(r["ev"], r["name"]) for r in flightrec.snapshot()}
+    assert ("span", "build.something") in kinds
+    assert ("event", "decision.made") in kinds
+    assert ("metric", "wisdom.hits") in kinds
+    st = flightrec.stats()
+    assert st["enabled"] and st["size"] == len(flightrec.snapshot())
+
+
+def test_ring_bounded_and_displacement_accounted(monkeypatch):
+    monkeypatch.setenv(flightrec.ENV_CAPACITY, "16")
+    for i in range(40):
+        flightrec.record("event", f"e{i}")
+    snap = flightrec.snapshot()
+    assert len(snap) == 16
+    assert snap[0]["name"] == "e24" and snap[-1]["name"] == "e39"
+    assert flightrec.stats()["dropped"] == 24
+
+
+def test_off_switch_drops_everything(monkeypatch):
+    monkeypatch.setenv(flightrec.ENV_OFF, "off")
+    flightrec.record("event", "dropped")
+    with obs.span("also.dropped"):
+        pass
+    assert flightrec.snapshot() == []
+    assert flightrec.trigger("manual", "nothing to dump") is None
+
+
+# ---------------------------------------------------------------------------
+# triggers, cooldown, degradation
+# ---------------------------------------------------------------------------
+
+def test_trigger_dumps_ring_oldest_first(tmp_path):
+    for i in range(5):
+        flightrec.record("event", f"e{i}", i=i)
+    path = flightrec.trigger("manual", "unit test", extra="x")
+    assert path and os.path.dirname(path) == str(tmp_path)
+    lines = [json.loads(ln) for ln in
+             open(path, encoding="utf-8").read().splitlines()]
+    header, body = lines[0], lines[1:]
+    assert header["ev"] == "flightrec" and header["trigger"] == "manual"
+    assert header["reason"] == "unit test"
+    assert header["attrs"] == {"extra": "x"}
+    assert header["records"] == 5
+    assert [r["name"] for r in body] == [f"e{i}" for i in range(5)]
+    assert flightrec.validate_dump_file(path) == 5
+    last = flightrec.last_dump()
+    assert last["path"] == path and last["trigger"] == "manual"
+    # The dump itself is accounted (cumulative counter + ring event).
+    assert obs.metrics.counter_value("flightrec.dumps") == 1
+
+
+def test_trigger_cooldown_rate_limits_per_kind(monkeypatch):
+    monkeypatch.setenv(flightrec.ENV_COOLDOWN, "3600")
+    assert flightrec.trigger("guard_violation", "first") is not None
+    assert flightrec.trigger("guard_violation", "storm") is None
+    # A DIFFERENT kind is not rate-limited by the first one's window.
+    assert flightrec.trigger("circuit_open", "other kind") is not None
+
+
+def test_unknown_trigger_coerces_to_manual():
+    path = flightrec.trigger("not-a-trigger", "coerced")
+    hdr = json.loads(open(path, encoding="utf-8").readline())
+    assert hdr["trigger"] == "manual"
+
+
+def test_unwritable_dump_dir_degrades(monkeypatch, tmp_path):
+    # A regular file where the dump DIRECTORY should be (permission bits
+    # would not stop a root test runner; a non-directory stops everyone).
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("occupied")
+    monkeypatch.setenv(flightrec.ENV_DIR, str(blocker))
+    flightrec.record("event", "e")
+    assert flightrec.trigger("manual", "lost") is None  # never raises
+    assert flightrec.last_dump() is None
+
+
+# ---------------------------------------------------------------------------
+# dump schema validation (the CI artifact checker)
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, lines):
+    p = tmp_path / "dump.jsonl"
+    p.write_text("\n".join(json.dumps(ln) for ln in lines) + "\n")
+    return str(p)
+
+
+def test_validate_dump_rejects_each_defect(tmp_path):
+    rec = {"ev": "event", "name": "e", "ts": 1.0, "pid": 1, "seq": 1,
+           "attrs": {}}
+    hdr = {"ev": "flightrec", "trigger": "manual", "reason": "", "ts": 1.0,
+           "pid": 1, "seq": 2, "records": 1, "attrs": {}}
+    assert flightrec.validate_dump_file(_write(tmp_path, [hdr, rec])) == 1
+    with pytest.raises(ValueError, match="first line"):
+        flightrec.validate_dump_file(_write(tmp_path, [rec, rec]))
+    with pytest.raises(ValueError, match="unknown trigger"):
+        flightrec.validate_dump_file(
+            _write(tmp_path, [dict(hdr, trigger="frobnicate"), rec]))
+    with pytest.raises(ValueError, match="claims"):
+        flightrec.validate_dump_file(
+            _write(tmp_path, [dict(hdr, records=7), rec]))
+    with pytest.raises(ValueError, match="record ts"):
+        flightrec.validate_dump_file(
+            _write(tmp_path, [hdr, dict(rec, ts="late")]))
+    with pytest.raises(ValueError, match="empty"):
+        flightrec.validate_dump_file(_write(tmp_path, []))
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end trigger chain (wire:bitflip -> GuardViolation -> dump)
+# ---------------------------------------------------------------------------
+
+def test_guard_violation_dumps_evidence_under_bitflip(devices, monkeypatch,
+                                                      tmp_path):
+    """The satellite-3 chain: an injected wire bit-flip under
+    guards=enforce raises ``GuardViolation`` AND leaves a schema-valid
+    flight-recorder dump whose body carries the violation evidence plus
+    the plan-build spans that preceded it."""
+    monkeypatch.setenv(inject.ENV_VAR, "wire:bitflip")
+    plan = dfft.SlabFFTPlan(dfft.GlobalSize(16, 16, 16),
+                            dfft.SlabPartition(8),
+                            dfft.Config(guards="enforce",
+                                        comm_method=dfft.CommMethod.ALL2ALL,
+                                        use_wisdom=False))
+    x = plan.pad_input(np.random.default_rng(0).random(plan.input_shape)
+                       .astype(np.float32))
+    with pytest.raises(GuardViolation):
+        plan.exec_r2c(x)
+    last = flightrec.last_dump()
+    assert last is not None and last["trigger"] == "guard_violation"
+    assert flightrec.validate_dump_file(last["path"]) == last["records"]
+    lines = [json.loads(ln) for ln in
+             open(last["path"], encoding="utf-8").read().splitlines()]
+    header, body = lines[0], lines[1:]
+    assert "parseval" in header["reason"]
+    names = [r["name"] for r in body]
+    # The evidence: the guard's own violation records ...
+    assert "guard.parseval_violations" in names      # metric delta
+    assert any(r["name"] == "guard.violation" for r in body
+               if r["ev"] == "event")
+    # ... preceded by the build-time spans of the plan that failed.
+    assert "plan.build" in names
+    assert names.index("plan.build") \
+        < names.index("guard.parseval_violations")
+
+
+def test_serve_health_reports_flightrec(devices):
+    """serve ``health()`` surfaces ring occupancy and the last dump path
+    (the operator's pointer to the post-mortem evidence)."""
+    from distributedfft_tpu.serve import Server
+    with Server() as s:
+        h = s.health()["flight_recorder"]
+        assert h["enabled"] and h["capacity"] >= 16
+        assert h["last_dump"] is None
+        flightrec.trigger("manual", "health test")
+        h2 = s.health()["flight_recorder"]
+        assert h2["last_dump"]["trigger"] == "manual"

@@ -88,15 +88,20 @@ def test_span_nesting_and_jsonl_schema_roundtrip(tmp_path):
     assert by_name["outer"]["seq"] == min(seqs)
 
 
-def test_span_disabled_is_shared_noop(tmp_path):
+def test_span_disabled_feeds_ring_only(tmp_path, monkeypatch):
+    """With the log off, spans/events do NO file I/O but still land in
+    the always-on flight-recorder ring (ISSUE 12); with the recorder
+    also off ($DFFT_FLIGHTREC=off) the span degrades to the shared
+    no-op context — the fully-dropped path still exists."""
     obs.disable()
-    s1, s2 = obs.span("a"), obs.span("b", k=1)
-    assert s1 is s2  # the shared null context: no per-call allocation
-    with s1:
+    obs.flightrec.clear()
+    with obs.span("ring.only", k=1):
         pass
-    obs.event("dropped")
-    obs.notice("dropped too")
-    assert obs.event_log_path() is None
+    obs.event("ring.event")
+    obs.notice("ring notice")
+    assert obs.event_log_path() is None  # no file surface
+    names = [r["name"] for r in obs.flightrec.snapshot()]
+    assert "ring.only" in names and "ring.event" in names
     # disable() beats the environment.
     import os
     os.environ[obs.ENV_VAR] = str(tmp_path)
@@ -104,6 +109,15 @@ def test_span_disabled_is_shared_noop(tmp_path):
         assert not obs.enabled()
     finally:
         del os.environ[obs.ENV_VAR]
+    # Recorder off too -> the shared null context, zero allocation.
+    monkeypatch.setenv("DFFT_FLIGHTREC", "off")
+    s1, s2 = obs.span("a"), obs.span("b", k=1)
+    assert s1 is s2
+    with s1:
+        pass
+    obs.flightrec.clear()
+    obs.event("fully.dropped")
+    assert obs.flightrec.snapshot() == []
 
 
 def test_validate_event_rejects_malformed():

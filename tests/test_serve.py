@@ -244,6 +244,43 @@ def test_coalesced_bit_identical_to_single_shot(devices):
         np.testing.assert_array_equal(a, b)
 
 
+def test_trace_id_propagates_through_coalesced_batch(devices):
+    """ISSUE 12: every admitted request gets a unique trace id that rides
+    its future AND the whole event chain — admit, the coalesce event of
+    the batch that served it, the execute span, and the reply — so one
+    request's path is reconstructable from the event log even when it
+    was answered inside a shared batch."""
+    from distributedfft_tpu.obs import flightrec
+    flightrec.clear()
+    imgs = [_img((24, 24), seed=i) for i in range(4)]
+    with Server(max_coalesce=8) as s:
+        # occupy the worker with a cold build on another key so the four
+        # same-key requests are queued together and coalesce
+        s.submit(np.zeros((8, 8), np.float32))
+        futs = [s.submit(x) for x in imgs]
+        [f.result(60) for f in futs]
+        assert s.health()["counters"]["coalesced"] >= 2
+    tids = [f.trace_id for f in futs]
+    assert all(tids) and len(set(tids)) == len(tids)  # unique, nonempty
+    recs = [r for r in flightrec.snapshot() if r["ev"] in ("event", "span")]
+    admits = {r["attrs"]["trace"] for r in recs
+              if r["name"] == "serve.admit"}
+    assert set(tids) <= admits
+    coalesces = [r["attrs"]["traces"] for r in recs
+                 if r["name"] == "serve.coalesce"]
+    for tid in tids:  # each id appears in EXACTLY one batch's coalesce
+        assert sum(tid in traces for traces in coalesces) == 1
+    assert any(len(set(tids) & set(traces)) >= 2 for traces in coalesces)
+    execs = [r["attrs"]["traces"] for r in recs
+             if r["name"] == "serve.execute"]
+    assert all(any(tid in traces for traces in execs) for tid in tids)
+    replies = {r["attrs"]["trace"]: r["attrs"] for r in recs
+               if r["name"] == "serve.reply"}
+    for tid in tids:
+        assert replies[tid]["outcome"] == "ok"
+        assert replies[tid]["coalesced_n"] >= 2
+
+
 def test_cache_hit_zero_recompiles(devices, monkeypatch):
     from distributedfft_tpu.models import batched2d as b2
     builds = []

@@ -36,6 +36,12 @@ eating the whole 480 s deadline with nothing emitted; see
   in-process ``serve.Server``) reporting p50/p99 latency, sustained
   FFTs/sec, shed counts and the plan-cache hit rate per rate. CPU-only
   like the mesh child, so it is tunnel-immune and strictly bounded.
+* Child 2d (``--child fleet``) is the fleet scaling bench (ISSUE 13):
+  the open-loop sweep re-driven against ``serve.Fleet`` at 1/2/4
+  subprocess workers behind the plan-key router, quoting achieved
+  FFTs/sec, p50/p99 and shed per worker count against the 1-worker
+  plateau — the measurement ROADMAP item 2's single-process→fleet
+  promotion is gated on. CPU-only, strictly bounded.
 * Child 3 (``--child tpu``) times the single-chip R2C+C2R roundtrip at
   128^3 and 256^3 with the shared chained-roundtrip harness
   (distributedfft_tpu/testing/chaintimer.py: scalar-fenced jitted fori_loop
@@ -79,6 +85,7 @@ BUDGET_S = 450               # parent wall-clock; driver's outer limit is >480
 PROBE_TIMEOUT_S = 180        # re-probe ceiling (first probe rides the budget)
 MESH_TIMEOUT_S = 300
 SERVE_TIMEOUT_S = 90         # serving-layer saturation bench (CPU, bounded)
+FLEET_TIMEOUT_S = 150        # fleet scaling bench (CPU, bounded; ISSUE 13)
 SOLVERS_TIMEOUT_S = 75       # solvers suite bench (CPU, bounded; ISSUE 9)
 MEASURE_RESERVE_S = 120      # budget step 3 needs after a successful probe
 # Default sweep covers the BASELINE metric's own sizes (VERDICT r3 item 7:
@@ -1048,6 +1055,142 @@ def _child_serve(deadline_s: int = 90) -> int:
     return 0
 
 
+def _child_fleet(deadline_s: int = FLEET_TIMEOUT_S) -> int:
+    """Fleet scaling bench (ISSUE 13; CPU-only, tunnel-immune): the
+    open-loop Poisson sweep driven against ``serve.Fleet`` at 1, 2 and
+    4 subprocess ``Server`` workers for one repeated shape. Each row
+    quotes achieved FFTs/sec, p50/p99 latency and shed count at ONE
+    FIXED offered rate — 2.2x the 1-worker warm capacity, past what one
+    worker can carry but absorbable by two — so the rows tell a stable
+    story (1 worker saturates and sheds at the latency budget; 2 and 4
+    absorb the same load with falling p99) instead of chasing a
+    per-worker rate that the submit harness and the rendezvous key
+    split both distort. ``speedup_vs_1`` is the committed scaling
+    claim. The traffic mixes over a 24-key SHAPE SET: plan-key affinity
+    routing scales with key diversity — a single hot key pins to one
+    worker by design, so a one-key sweep would measure nothing but that
+    worker. Workers are real subprocesses sharing this host's cores
+    (spawn + jax import per worker — ``spawn_s`` is the honest cost of
+    a scale-up), so the CPU rows bound below ideal scaling."""
+    import numpy as np
+
+    from distributedfft_tpu.serve import Fleet
+    from distributedfft_tpu.testing.workloads import serve_load
+
+    out = {}
+
+    def _handler(signum, frame):
+        raise TimeoutError("fleet child deadline")
+    signal.signal(signal.SIGALRM, _handler)
+    signal.alarm(max(30, deadline_s - 10))
+    rows = []
+    try:
+        n = int(os.environ.get("DFFT_BENCH_FLEET_N", "48"))
+        shapes = [(n + 2 * i, n + 2 * i) for i in range(24)]
+        rng = np.random.default_rng(0)
+        rate = None
+        for workers in (1, 2, 4):
+            t0 = time.perf_counter()
+            # cache_capacity covers the whole key mix so every row
+            # measures compute capacity, not LRU thrash — the 1-worker
+            # baseline would otherwise rebuild plans all drive long
+            # (24 keys > the default 8 slots), flattering the fleet.
+            # Each worker is pinned to ONE intra-op thread: XLA CPU
+            # otherwise threads every FFT across all host cores, so a
+            # single worker already saturates the box and extra
+            # processes only oversubscribe (measured: 4 workers SLOWER
+            # than 1 without the pin) — with it, fleet scaling is real
+            # process-level parallelism up to the core count.
+            single = {"XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
+                                   "intra_op_parallelism_threads=1",
+                      "OMP_NUM_THREADS": "1",
+                      "OPENBLAS_NUM_THREADS": "1"}
+            f = Fleet(workers, worker_backend="server",
+                      heartbeat_interval_s=0.5, max_coalesce=1,
+                      cache_capacity=len(shapes) + 2,
+                      worker_env=single,
+                      latency_budget_ms=500.0)
+            graceful = False
+            try:
+                spawn_s = time.perf_counter() - t0
+                # Warm through ROUTED requests (3 per key) so exactly
+                # the owner worker of each key compiles its plan —
+                # bucket prewarm across all workers would dominate the
+                # child budget for plans that never serve.
+                warm = []
+                for shape in shapes:
+                    # First request per key pays the cold plan build;
+                    # only the SECOND (warm) one feeds the capacity
+                    # estimate.
+                    for i in range(2):
+                        x = rng.random(shape, dtype=np.float32)
+                        t1 = time.perf_counter()
+                        f.request(x, timeout_s=60)
+                        if i:
+                            warm.append((time.perf_counter() - t1) * 1e3)
+                if rate is None:
+                    # Fixed offered load for EVERY row: 2.2x the
+                    # 1-worker warm capacity (bounded so the open-loop
+                    # submit harness itself can hold the schedule).
+                    base = 1e3 / max(float(np.median(warm)), 1e-3)
+                    rate = round(min(2.2 * base, 700.0), 1)
+                r = serve_load(f, rate_hz=rate, duration_s=2.0,
+                               shapes=shapes, seed=1, warmup=0)
+                h = f.health()
+                rows.append({
+                    "workers": workers, "spawn_s": round(spawn_s, 2),
+                    "offered_rate_hz": rate,
+                    "achieved_fps": r["achieved_fps"],
+                    "p50_ms": r["p50_ms"], "p99_ms": r["p99_ms"],
+                    "shed": r["outcomes"]["shed"],
+                    "worker_deaths": h["counters"]["worker_deaths"],
+                })
+                f.close(drain=True, timeout_s=30.0)
+                graceful = True
+            finally:
+                if not graceful:
+                    # The alarm (or any failure) landed mid-drive: a
+                    # drain=True close here could outlive the parent's
+                    # 10 s post-alarm kill margin and lose the salvage
+                    # JSON below — drop the queue and report partial
+                    # rows instead (close is idempotent).
+                    f.close(drain=False, timeout_s=5.0)
+        out["scaling"] = rows
+        out["shapes"] = [list(s) for s in shapes]
+        import multiprocessing as _mp
+        out["host_cores"] = _mp.cpu_count()
+        out["note"] = ("open-loop Poisson arrivals (serve_load) against "
+                       "serve.Fleet (real subprocess Server workers "
+                       "pinned to ONE intra-op thread each, rendezvous "
+                       "plan-key routing over a 24-key shape mix, "
+                       "max_coalesce=1) on the CPU backend; ONE fixed "
+                       "offered rate (2.2x the 1-worker warm capacity) "
+                       "for every row. Expect speedup_vs_1 to rise to "
+                       "~host_cores workers and DEGRADE past it (router "
+                       "+ worker processes oversubscribe the shared "
+                       "cores) — the scaling claim is per-core, the "
+                       "TPU-host fleet is where per-worker accelerators "
+                       "make it linear. Compare achieved_fps against "
+                       "BENCH_DETAILS.json's \"serve\" single-process "
+                       "sweep plateau.")
+    except TimeoutError as e:
+        out["partial"] = True
+        out["error"] = str(e)
+        out.setdefault("scaling", rows)  # keep the rows already measured
+    except Exception as e:  # noqa: BLE001 — still print what was measured
+        out["partial"] = True
+        out["error"] = f"{type(e).__name__}: {e}"
+        out.setdefault("scaling", rows)
+    if out.get("scaling"):
+        ref = out["scaling"][0]["achieved_fps"] or 1.0
+        for row in out["scaling"]:
+            row["speedup_vs_1"] = round(row["achieved_fps"] / ref, 2)
+    _fold_obs_metrics(out)
+    signal.alarm(0)
+    print(json.dumps(out))
+    return 0
+
+
 def _child_solvers(deadline_s: int = SOLVERS_TIMEOUT_S) -> int:
     """Solvers-suite bench (ISSUE 9; CPU mesh, tunnel-immune): (1) the
     Navier-Stokes RK4 step time — 2D vorticity ensemble on the batched-2D
@@ -1270,7 +1413,7 @@ def _child_budget(name: str, default: float) -> float:
     number applying to every child (``DFFT_BENCH_CHILD_TIMEOUT_S=120``)
     or per-child ``name:seconds`` pairs, comma-separated
     (``mesh:120,tpu:180,probe:60``; children: probe, mesh, serve,
-    solvers, tpu). The value OVERRIDES the built-in default for that
+    fleet, solvers, tpu). The value OVERRIDES the built-in default for that
     child but is still bounded by the parent's remaining budget above
     the measurement reserve (main() min()s as before). Malformed tokens
     are ignored — a typo'd env must not kill a bench run."""
@@ -1430,6 +1573,22 @@ def main() -> int:
             diags.append(d)
     else:
         diags.append("serve: skipped, no budget above the measurement "
+                     "reserve")
+
+    # 2b'. Fleet scaling bench (ISSUE 13): CPU-only like the serve
+    #     child — achieved FFTs/sec at 1/2/4 subprocess workers vs the
+    #     single-process plateau; spawn-heavy, so it gets its own
+    #     (larger) default budget and skips first when time is short.
+    fleet = None
+    fleet_grant = min(_child_budget("fleet", FLEET_TIMEOUT_S),
+                      remaining() - MEASURE_RESERVE_S)
+    if fleet_grant >= 45:
+        fleet, d = _run_child("fleet", fleet_grant,
+                              extra=(int(fleet_grant),))
+        if d:
+            diags.append(d)
+    else:
+        diags.append("fleet: skipped, no budget above the measurement "
                      "reserve")
 
     # 2c. Solvers-suite bench (ISSUE 9): CPU-only, short and bounded —
@@ -1659,6 +1818,11 @@ def main() -> int:
         # latency and the offered-load sweep (p50/p99, FFTs/sec, shed,
         # plan-cache hit rate) — ROADMAP item 2's decision measurement.
         result["serve"] = serve
+    if fleet:
+        # Fleet scaling record (ISSUE 13): achieved FFTs/sec, p50/p99
+        # and shed at 1/2/4 subprocess workers behind the plan-key
+        # router, vs the single-process "serve" sweep plateau.
+        result["fleet"] = fleet
     if solvers:
         # Solvers-suite record (ISSUE 9): NS RK4 step time (2D ensemble +
         # 3D cube) and Bluestein-vs-zero-padded prime-size throughput.
@@ -1751,6 +1915,9 @@ if __name__ == "__main__":
         if name == "serve":
             sys.exit(_child_serve(int(sys.argv[3]) if len(sys.argv) > 3
                                   else SERVE_TIMEOUT_S))
+        if name == "fleet":
+            sys.exit(_child_fleet(int(sys.argv[3]) if len(sys.argv) > 3
+                                  else FLEET_TIMEOUT_S))
         if name == "solvers":
             sys.exit(_child_solvers(int(sys.argv[3]) if len(sys.argv) > 3
                                     else SOLVERS_TIMEOUT_S))

@@ -21,6 +21,13 @@ trigger                fired by
 ``fallback_demotion``  the PR 5 fallback ladder walking a rung
 ``shed_burst``         >= ``DFFT_FLIGHTREC_SHED_BURST`` admissions shed
                        within 2 s (``serve/server.py``)
+``worker_death``       the fleet failure detector declaring a worker dead
+                       (``serve/fleet.py``: missed heartbeats, broken
+                       pipe, or a nonzero exit) — the dump carries the
+                       beats/dispatches of the worker's final seconds
+``scale_decision``     the fleet's worker-count controller acting on the
+                       ``/metrics`` signals (``serve/fleet.py``) — the
+                       auditable record of WHY capacity changed
 ``signal``             SIGUSR2 (``install_signal_handler``; the live-
                        debugging surface: kill -USR2 a stuck server)
 ``manual``             programmatic ``dump()``
@@ -59,7 +66,8 @@ ENV_COOLDOWN = "DFFT_FLIGHTREC_COOLDOWN_S"
 DEFAULT_CAPACITY = 2048
 
 TRIGGERS = ("guard_violation", "circuit_open", "fallback_demotion",
-            "shed_burst", "signal", "manual")
+            "shed_burst", "worker_death", "scale_decision", "signal",
+            "manual")
 
 _LOCK = threading.Lock()
 _RING: Deque[Dict[str, Any]] = collections.deque(maxlen=DEFAULT_CAPACITY)

@@ -87,11 +87,38 @@ serve.ema_ms               gauge   per-request execution EMA (warm batches)
 serve.queue_wait_ms        histo   admission -> execution start, per request
 serve.exec_ms              histo   warm batch execution / batch size
 serve.e2e_ms               histo   admission -> reply, served requests only
+fleet.workers              gauge   live (in-ring) worker count — the
+                                   scale controller's own output signal
+fleet.pending              gauge   router-held requests not yet dispatched
+fleet.outstanding          gauge   admitted requests not yet resolved
+fleet.admitted             counter requests admitted by the fleet router
+fleet.served               counter requests resolved with a result
+fleet.shed                 counter router admissions rejected Overloaded
+fleet.resubmitted          counter in-flight requests rerouted after a
+                                   worker death (idempotent by trace id)
+fleet.worker_deaths        counter workers declared dead (beats/pipe/exit)
+fleet.worker_restarts      counter replacement workers joined the ring
+fleet.scale_decisions      counter controller decisions acted on (up/down)
+inject.worker_crashes      counter injected worker:crash exits (counted
+                                   in the WORKER process's registry —
+                                   read them from the worker's event
+                                   log, not the router's /metrics)
+inject.worker_hangs        counter injected worker:hang stalls (worker-
+                                   local, like worker_crashes)
 ========================== ======= ==========================================
+
+**Labels**: a metric name may carry a ``[key=value,...]`` suffix (build
+it with :func:`labeled`); the registry treats the whole string as one
+series and the Prometheus exposition (``promexp.py``) renders the suffix
+as real labels under a single per-family TYPE header. The fleet records
+``fleet.tenant.shed[tenant=...]`` / ``fleet.tenant.outstanding[tenant=...]``
+per tenant and ``fleet.worker.queue_depth[worker=...]`` /
+``fleet.worker.inflight[worker=...]`` per worker this way.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict, List, Tuple, Union
 
@@ -116,6 +143,27 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
 VIEWS = ("plan", "cumulative")
 
 
+def labeled(name: str, **labels: object) -> str:
+    """Build a labeled series name: ``labeled("fleet.tenant.shed",
+    tenant="acme") -> "fleet.tenant.shed[tenant=acme]"``. Keys are
+    sorted so the same label set always names the same series. Label
+    VALUES are user-controlled (tenant names arrive from ``submit``),
+    so the convention's AND the exposition's structural characters —
+    ``[ ] { } , =`` plus quotes/backslashes/newlines — are folded to
+    ``_``: a hostile name
+    may collide with another sanitized name, but it can never invent a
+    label dimension or corrupt the exposition."""
+    if not labels:
+        return name
+    body = ",".join(
+        f"{k}={_LABEL_UNSAFE.sub('_', str(labels[k]))}"
+        for k in sorted(labels))
+    return f"{name}[{body}]"
+
+
+_LABEL_UNSAFE = re.compile(r'[\[\]{},="\\\n]')
+
+
 def inc(name: str, n: Number = 1) -> None:
     """Add ``n`` to counter ``name`` (creating it at 0). The delta also
     lands in the flight-recorder ring (``obs/flightrec.py``), so a dump
@@ -130,6 +178,15 @@ def gauge(name: str, value: Number) -> None:
     """Set gauge ``name`` to ``value`` (last write wins)."""
     with _LOCK:
         _GAUGES[name] = value
+
+
+def drop_gauge(name: str) -> None:
+    """Remove gauge ``name`` from BOTH views (a gauge describes current
+    state; when its subject permanently departs — a fleet worker slot
+    retired by scale-down — a frozen last value is misinformation on
+    the scrape surface, not history worth keeping)."""
+    with _LOCK:
+        _GAUGES.pop(name, None)
 
 
 def observe(name: str, value_ms: Number,

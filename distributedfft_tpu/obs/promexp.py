@@ -15,6 +15,15 @@ Metric names are sanitized (dots and other non-name characters become
 ``_``) and prefixed ``dfft_``; the original registry name is kept in the
 ``# HELP`` line so the mapping stays greppable.
 
+**Label convention** (ISSUE 13): the flat registry encodes Prometheus
+labels in the metric NAME as a ``[key=value,...]`` suffix —
+``metrics.inc("fleet.tenant.shed[tenant=acme]")`` renders as
+``dfft_fleet_tenant_shed_total{tenant="acme"} 1``. Every labeled series
+of a family shares ONE ``# TYPE``/``# HELP`` header (the exposition
+format forbids duplicates), and label values are escaped per the
+exposition rules. ``obs.metrics.labeled`` builds the convention; the
+fleet uses it for per-tenant and per-worker series.
+
 ``validate_exposition`` is a strict-enough format checker for CI and
 tests: line grammar, TYPE-before-samples, histogram bucket monotonicity
 and the ``+Inf``-bucket == ``_count`` invariant. It validates structure,
@@ -49,6 +58,34 @@ def sanitize(name: str) -> str:
     return out
 
 
+_LABELED_NAME_RE = re.compile(r"^(.*?)\[([^\]]*)\]$")
+
+
+def split_labels(name: str) -> Tuple[str, Dict[str, str]]:
+    """Split a registry name carrying the ``[k=v,...]`` label suffix into
+    ``(base_name, labels)``; a name without the suffix (or with a
+    malformed one) is returned whole with no labels — the registry never
+    rejects a metric name, so neither does the renderer."""
+    m = _LABELED_NAME_RE.match(str(name))
+    if not m:
+        return str(name), {}
+    labels: Dict[str, str] = {}
+    for pair in m.group(2).split(","):
+        k, sep, v = pair.partition("=")
+        if not sep or not k.strip():
+            return str(name), {}
+        labels[sanitize(k.strip())] = v.strip()
+    return m.group(1), labels
+
+
+def _label_body(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    esc = {k: v.replace("\\", r"\\").replace('"', r"\"")
+           .replace("\n", r"\n") for k, v in sorted(labels.items())}
+    return "{" + ",".join(f'{k}="{v}"' for k, v in esc.items()) + "}"
+
+
 def _fmt(v: Any) -> str:
     f = float(v)
     if math.isinf(f):
@@ -66,17 +103,30 @@ def render(snapshot: Optional[Dict[str, Any]] = None,
     snap = snapshot if snapshot is not None \
         else metrics.snapshot(view="cumulative")
     lines: List[str] = []
-    for name, value in snap.get("counters", {}).items():
-        m = f"{prefix}_{sanitize(name)}_total"
-        lines.append(f"# HELP {m} obs counter {name!r} "
-                     "(cumulative, monotone across obs.reset())")
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(value)}")
-    for name, value in snap.get("gauges", {}).items():
-        m = f"{prefix}_{sanitize(name)}"
-        lines.append(f"# HELP {m} obs gauge {name!r} (last value set)")
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(value)}")
+    # Labeled series ([k=v] name suffixes) of one family share a single
+    # HELP/TYPE header (the format forbids duplicates): group per
+    # sanitized family in first-appearance order, samples in registry
+    # (sorted-name) order within each family.
+    for kind, suffix, store in (("counter", "_total",
+                                 snap.get("counters", {})),
+                                ("gauge", "", snap.get("gauges", {}))):
+        order: List[str] = []
+        families: Dict[str, List[Tuple[str, Dict[str, str], Any]]] = {}
+        for name, value in store.items():
+            base, labels = split_labels(name)
+            m = f"{prefix}_{sanitize(base)}{suffix}"
+            if m not in families:
+                families[m] = []
+                order.append(m)
+            families[m].append((base, labels, value))
+        for m in order:
+            base = families[m][0][0]
+            desc = ("(cumulative, monotone across obs.reset())"
+                    if kind == "counter" else "(last value set)")
+            lines.append(f"# HELP {m} obs {kind} {base!r} {desc}")
+            lines.append(f"# TYPE {m} {kind}")
+            for _, labels, value in families[m]:
+                lines.append(f"{m}{_label_body(labels)} {_fmt(value)}")
     for name, h in snap.get("histograms", {}).items():
         m = f"{prefix}_{sanitize(name)}"
         lines.append(f"# HELP {m} obs histogram {name!r} "

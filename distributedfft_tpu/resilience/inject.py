@@ -23,6 +23,17 @@ same live server)::
     server:slow[:MS]         # host-side straggler: sleep MS milliseconds
                              # (50 default) inside the serve execution path
                              # (exercises deadline expiry + load shedding)
+    worker:crash[:K]         # fleet worker @seed=I (its worker INDEX,
+                             # default 0) exits abruptly (os._exit) on
+                             # RECEIPT of its K-th request (default 1,
+                             # i.e. before answering it; K-1 answered) —
+                             # the kill-a-worker chaos drill; the failure
+                             # detector must declare it dead, reroute its
+                             # keys and resubmit its in-flight requests
+    worker:hang[:MS]         # fleet worker @seed=I stops responding for
+                             # MS milliseconds (default 60000) per message
+                             # — exercises the K-missed-heartbeats path
+                             # (vs crash's broken-pipe path)
     coordinator:down[:K]     # coordinator connect fails (first K attempts;
                              # no K = every attempt)
     wisdom:stale-lock        # the wisdom advisory flock reads as held by a
@@ -34,7 +45,11 @@ At most one fault per KIND — duplicates are rejected at parse (two wire
 faults in one process would make the corrupted image ambiguous).
 
 ``seed`` (default 0) keys the corrupted element index, so a chaos run is
-reproducible bit-for-bit. The wire injectors corrupt the payload at the
+reproducible bit-for-bit; for the ``worker:*`` faults the seed instead
+selects the VICTIM worker index (the fleet numbers its workers), and only
+the worker's FIRST incarnation is faulted — the replacement the fleet
+respawns is clean, so a chaos drill kills each worker slot once instead
+of crash-looping it. The wire injectors corrupt the payload at the
 ``wire_encode``/``wire_decode`` boundary in ``parallel/transpose.py`` —
 AFTER the encode, so what travels (and what the guards must catch) is the
 corrupted wire image, exactly like a real ICI/DCN fault. Injection sites
@@ -60,6 +75,7 @@ _WIRE_MODES = ("nan", "bitflip", "scale")
 _KINDS = {
     "wire": _WIRE_MODES,
     "server": ("slow",),
+    "worker": ("crash", "hang"),
     "coordinator": ("down",),
     "wisdom": ("stale-lock",),
     "autotune": ("hang",),
@@ -252,6 +268,50 @@ def maybe_slow_server(where: str) -> None:
     delay_ms = 50.0 if spec.param is None else float(spec.param)
     obs.metrics.inc("inject.server_slow")
     obs.event("inject.server_slow", where=where, ms=delay_ms)
+    time.sleep(delay_ms / 1e3)
+
+
+# Requests handled by THIS process's worker loop (worker:crash counts
+# them; fresh per spawned worker process by construction).
+_WORKER_REQS = [0]
+
+
+def maybe_crash_worker(index: int, generation: int = 0) -> None:
+    """Simulate an abrupt fleet-worker death (``worker:crash[:K]``): the
+    worker whose index matches the spec's seed calls ``os._exit`` on
+    RECEIPT of its K-th request (default 1), before answering it — so
+    K-1 requests are answered and the K-th dies with the worker, no
+    drain, no goodbye message, exactly like an OOM-kill. Only
+    generation 0 (the original spawn) is faulted: the replacement worker
+    must come back clean so the fleet's death -> reroute -> restart ->
+    rejoin chain is observable once."""
+    spec = _spec_of("worker")
+    if spec is None or spec.mode != "crash":
+        return
+    if generation != 0 or int(index) != spec.seed:
+        return
+    _WORKER_REQS[0] += 1
+    k = 1 if spec.param is None else max(1, int(spec.param))
+    if _WORKER_REQS[0] >= k:
+        obs.metrics.inc("inject.worker_crashes")
+        obs.event("inject.worker_crash", worker=int(index), after=k)
+        os._exit(17)
+
+
+def maybe_hang_worker(index: int, generation: int = 0) -> None:
+    """Simulate a hung fleet worker (``worker:hang[:MS]``, default
+    60000 ms): the victim worker sleeps before processing each pipe
+    message, so it stops answering heartbeats while its process stays
+    alive — the failure detector must declare it dead on K missed beats
+    (not a broken pipe) and the fleet must terminate + replace it."""
+    spec = _spec_of("worker")
+    if spec is None or spec.mode != "hang":
+        return
+    if generation != 0 or int(index) != spec.seed:
+        return
+    delay_ms = 60000.0 if spec.param is None else float(spec.param)
+    obs.metrics.inc("inject.worker_hangs")
+    obs.event("inject.worker_hang", worker=int(index), ms=delay_ms)
     time.sleep(delay_ms / 1e3)
 
 

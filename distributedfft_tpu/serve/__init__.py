@@ -28,12 +28,17 @@ exactly that).
 """
 
 from . import plancache
-from .plancache import PlanCache, bucket_for, cache_key, request_key
-from .server import Overloaded, Server, ServerClosed
+from .fleet import Fleet, RemoteWorkerError, ScaleController
+from .plancache import (PlanCache, bucket_for, cache_key,
+                        parse_request_key, request_key)
+from .router import FairQueue, RendezvousRing, TenantPolicy
+from .server import Overloaded, Server, ServerClosed, normalize_request
 
 __all__ = [
-    "Overloaded", "PlanCache", "Server", "ServerClosed", "bucket_for",
-    "cache_key", "describe_request", "plancache", "request_key",
+    "FairQueue", "Fleet", "Overloaded", "PlanCache", "RemoteWorkerError",
+    "RendezvousRing", "ScaleController", "Server", "ServerClosed",
+    "TenantPolicy", "bucket_for", "cache_key", "describe_request",
+    "normalize_request", "parse_request_key", "plancache", "request_key",
 ]
 
 

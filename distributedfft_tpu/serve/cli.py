@@ -25,11 +25,22 @@ finishes, new admissions are rejected with ``ServerClosed``, wisdom and
 the obs event log are already flushed (atomic replace / per-line
 append), and the process exits 0 — the contract a rolling restart needs.
 
+``--workers N`` (or ``--autoscale MIN:MAX``) promotes the process to a
+**fleet** (ISSUE 13): N subprocess workers each running the Server core
+behind the rendezvous plan-key router (``serve/fleet.py``), with the
+heartbeat failure detector, per-tenant quotas (``--tenant-weights``) and
+the metrics-driven worker-count controller. The same ``--drive``/
+``--http`` surfaces apply; ``/healthz`` returns the FLEET snapshot
+(workers, ring, tenants, scale decisions).
+
 Examples::
 
     dfft-serve --drive --rate 50 --duration 10 --shapes 256x256,128x128 \
         --deadline-ms 500 --emulate-devices 8
     dfft-serve --http 8080 --emulate-devices 8   # curl :8080/healthz
+    dfft-serve --drive --workers 3 --rate 60 --duration 10 \
+        --shapes 64x64 --tenants gold,free --tenant-weights gold=3
+    dfft-serve --drive --autoscale 1:4 --rate 120 --duration 20
 """
 
 from __future__ import annotations
@@ -93,6 +104,38 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--obs-dir", default=None, metavar="DIR",
                     help="write the structured JSONL event log here "
                          "(same as $DFFT_OBS_DIR)")
+    # fleet mode (ISSUE 13): N shared-nothing subprocess workers behind
+    # the plan-key router; 0 = the classic single-process Server.
+    ap.add_argument("--workers", type=int, default=0,
+                    help="run a fleet of N subprocess workers behind the "
+                         "plan-key router (0 = single in-process server)")
+    ap.add_argument("--worker-backend", default="server",
+                    choices=("server", "stub"),
+                    help="fleet worker core: the real jax Server, or the "
+                         "np.fft stub with a fixed service time (routing/"
+                         "chaos experiments without compiles)")
+    ap.add_argument("--heartbeat-interval-s", type=float, default=0.5,
+                    help="fleet heartbeat period; a worker silent for "
+                         "K intervals is declared dead")
+    ap.add_argument("--heartbeat-k", type=int, default=3,
+                    help="missed heartbeats that declare a worker dead")
+    ap.add_argument("--worker-inflight", type=int, default=4,
+                    help="router dispatch window per worker (the "
+                         "tenant-fairness lever)")
+    ap.add_argument("--tenant-weights", default=None, metavar="T=W,...",
+                    help="per-tenant admission weights, e.g. "
+                         "'gold=3,free=1' (fleet mode; unknown tenants "
+                         "weigh 1)")
+    ap.add_argument("--tenants", default=None, metavar="A,B,...",
+                    help="mix the --drive traffic over these tenant "
+                         "identities (fleet mode; adds a by_tenant "
+                         "summary block)")
+    ap.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="attach the metrics-driven worker-count "
+                         "controller, bounded to [MIN, MAX] workers "
+                         "(fleet mode)")
+    ap.add_argument("--scale-cooldown-s", type=float, default=5.0,
+                    help="minimum seconds between scale decisions")
     ap.add_argument("--http", type=int, default=0, metavar="PORT",
                     help="serve GET /healthz, GET /readyz and POST /fft "
                          "on this port (0 = off)")
@@ -125,6 +168,39 @@ def build_parser() -> argparse.ArgumentParser:
                          "before the measured window (0 = cold)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
+
+
+def _parse_tenant_weights(s):
+    if not s:
+        return None
+    out = {}
+    for tok in s.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, w = tok.partition("=")
+        if not sep or not name.strip():
+            raise SystemExit(f"--tenant-weights wants T=W pairs, got "
+                             f"{tok!r}")
+        try:
+            out[name.strip()] = float(w)
+        except ValueError:
+            raise SystemExit(f"--tenant-weights weight not a number: "
+                             f"{tok!r}") from None
+    return out or None
+
+
+def _parse_autoscale(s):
+    if not s:
+        return None
+    lo, sep, hi = s.partition(":")
+    try:
+        pair = (int(lo), int(hi if sep else lo))
+    except ValueError:
+        raise SystemExit(f"--autoscale wants MIN:MAX, got {s!r}") from None
+    if not 1 <= pair[0] <= pair[1]:
+        raise SystemExit(f"--autoscale needs 1 <= MIN <= MAX, got {s!r}")
+    return pair
 
 
 def _parse_shapes(s: str):
@@ -264,14 +340,43 @@ def main(argv=None) -> int:
         opt=args.opt, fft_backend=args.fft_backend,
         wire_dtype=args.wire_dtype, guards=args.guards,
         wisdom_path=args.wisdom, use_wisdom=not args.no_wisdom)
-    server = Server(
-        pm.SlabPartition(args.partitions), cfg, shard=args.shard,
+    server_kwargs = dict(
         max_queue=args.max_queue,
         latency_budget_ms=args.latency_budget_ms,
         max_coalesce=args.max_coalesce,
         batch_chunk=args.batch_chunk or None,
         cache_capacity=args.cache_capacity, circuit_k=args.circuit_k,
         circuit_cooldown_s=args.circuit_cooldown_s)
+    autoscale = _parse_autoscale(args.autoscale)
+    if args.workers or autoscale:
+        # Fleet mode (ISSUE 13): N shared-nothing subprocess workers,
+        # each a full Server, behind the rendezvous plan-key router.
+        from .fleet import Fleet, ScaleController
+        n0 = args.workers or autoscale[0]
+        if autoscale:
+            n0 = min(max(n0, autoscale[0]), autoscale[1])
+        server = Fleet(
+            n0, partition=pm.SlabPartition(args.partitions), config=cfg,
+            shard=args.shard, emulate_devices=args.emulate_devices,
+            worker_backend=args.worker_backend,
+            heartbeat_interval_s=args.heartbeat_interval_s,
+            heartbeat_k=args.heartbeat_k,
+            worker_inflight=args.worker_inflight,
+            tenant_weights=_parse_tenant_weights(args.tenant_weights),
+            **server_kwargs)
+        if autoscale:
+            server.attach_controller(ScaleController(
+                server, autoscale[0], autoscale[1],
+                cooldown_s=args.scale_cooldown_s))
+    else:
+        if args.tenants or args.tenant_weights:
+            # Server.submit has no tenant axis: forwarding the flag
+            # would TypeError every request into a silent 100%-failed
+            # drive. Fail loudly at startup instead.
+            raise SystemExit("--tenants/--tenant-weights require fleet "
+                             "mode (--workers N or --autoscale MIN:MAX)")
+        server = Server(pm.SlabPartition(args.partitions), cfg,
+                        shard=args.shard, **server_kwargs)
 
     httpd = _make_http(server, args.http) if args.http else None
     stop = threading.Event()
@@ -302,6 +407,9 @@ def main(argv=None) -> int:
                                   args.transforms.split(",") if t.strip()],
                       deadline_ms=args.deadline_ms, seed=args.seed,
                       warmup=args.warmup, stop=stop)
+            if args.tenants:
+                kw["tenants"] = [t.strip() for t in
+                                 args.tenants.split(",") if t.strip()]
             if args.requests:
                 kw["n_requests"] = args.requests
             else:
@@ -331,6 +439,12 @@ def main(argv=None) -> int:
                 rc = 1
         if summary is not None:
             summary["health_status"] = health["status"]
+            if args.workers or autoscale:
+                summary["workers"] = len(health.get("ring", []))
+                summary["worker_deaths"] = \
+                    health["counters"].get("worker_deaths", 0)
+                summary["resubmitted"] = \
+                    health["counters"].get("resubmitted", 0)
             print(json.dumps(summary, sort_keys=True), flush=True)
         if args.obs:
             print("obs metrics: "

@@ -45,6 +45,25 @@ def cache_key(base_key: str, bucket: int) -> str:
     return f"{base_key}#b{bucket}"
 
 
+def parse_request_key(key: str) -> Dict[str, Any]:
+    """Invert :func:`request_key` (any ``#b<bucket>`` suffix ignored):
+    ``{"nx", "ny", "dtype", "transform", "shard"}``. The fleet uses this
+    to turn the hot-key set it tracked for a dead worker back into the
+    concrete shapes the REPLACEMENT must ``prewarm()`` before rejoining
+    the ring. Raises ``ValueError`` on a malformed key."""
+    base = key.split("#", 1)[0]
+    parts = base.split("/")
+    if len(parts) != 5 or parts[0] != "fft2d":
+        raise ValueError(f"not a serve request key: {key!r}")
+    nx, sep, ny = parts[1].partition("x")
+    if not sep or not nx.isdigit() or not ny.isdigit():
+        raise ValueError(f"malformed shape in request key: {key!r}")
+    if parts[2] not in ("f32", "f64") or parts[3] not in ("r2c", "c2c"):
+        raise ValueError(f"malformed dtype/transform in key: {key!r}")
+    return {"nx": int(nx), "ny": int(ny), "dtype": parts[2],
+            "transform": parts[3], "shard": parts[4]}
+
+
 class PlanCache:
     """Bounded LRU of live plan objects (thread-safe)."""
 

@@ -44,7 +44,7 @@ import dataclasses
 import os
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -97,6 +97,47 @@ class _Request:
         return (self.base_key, self.direction)
 
 
+def normalize_request(x: Any, transform: str, direction: str,
+                      ny: Optional[int]) -> Tuple[np.ndarray, int, int, bool]:
+    """Validate one request payload; returns ``(x, nx, ny, double)`` with
+    ``ny`` the LOGICAL real width (needed to key/construct the plan — a
+    spectral r2c payload alone cannot distinguish even/odd ny, so inverse
+    r2c callers may pass it; default assumes even). Module-level so the
+    fleet router (``fleet.py``) validates and keys requests with EXACTLY
+    the vocabulary each worker's ``Server`` will use."""
+    if transform not in ("r2c", "c2c"):
+        raise ValueError(f"transform must be r2c|c2c, got {transform!r}")
+    if direction not in ("forward", "inverse"):
+        raise ValueError(
+            f"direction must be forward|inverse, got {direction!r}")
+    x = np.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(
+            f"serve requests are single 2D images, got shape {x.shape} "
+            "(batching is the server's job — submit images "
+            "concurrently and they coalesce)")
+    complex_in = (transform == "c2c") or (direction == "inverse")
+    if complex_in != np.iscomplexobj(x):
+        raise ValueError(
+            f"{transform} {direction} expects a "
+            f"{'complex' if complex_in else 'real'} payload, got "
+            f"dtype {x.dtype}")
+    double = x.dtype in (np.float64, np.complex128)
+    if transform == "c2c" or direction == "forward":
+        nx_, ny_ = int(x.shape[0]), int(x.shape[1])
+        if ny is not None and int(ny) != ny_:
+            raise ValueError(f"ny {ny} disagrees with payload {x.shape}")
+        return x, nx_, ny_, double
+    # inverse r2c: payload is (nx, ny//2 + 1) spectral
+    nx_, nys = int(x.shape[0]), int(x.shape[1])
+    ny_ = int(ny) if ny is not None else 2 * (nys - 1)
+    if ny_ // 2 + 1 != nys:
+        raise ValueError(
+            f"ny {ny_} inconsistent with spectral payload {x.shape} "
+            f"(expects ny//2+1 == {nys})")
+    return x, nx_, ny_, double
+
+
 _EMA_ALPHA = 0.2
 
 # Per-process trace-id counter: ids are ``<pid hex>-<seq hex>`` — unique
@@ -116,6 +157,27 @@ def _new_trace_id() -> str:
     with _TRACE_LOCK:
         _TRACE_SEQ[0] += 1
         return f"{os.getpid():x}-{_TRACE_SEQ[0]:06x}"
+
+
+def settle_future(fut: Future, *, result: Any = None,
+                  exc: Optional[BaseException] = None) -> bool:
+    """Resolve ``fut`` exactly once against a CONCURRENT resolver. The
+    ``done()`` pre-check alone is check-then-act: close() answering a
+    timed-out worker's popped batch races the still-running worker
+    delivering the same futures, and both sides can pass ``done()``
+    before either sets — the loser's ``set_*`` raises
+    ``InvalidStateError``. Swallowing it here makes every resolution
+    site atomic (first writer wins, the loser reports False)."""
+    if fut.done():
+        return False
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        return False
+    return True
 
 
 class Server:
@@ -158,6 +220,7 @@ class Server:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: List[_Request] = []
+        self._inflight_reqs: List[_Request] = []
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._ema_ms: Optional[float] = None
         self._state = "running"  # running | draining | stopped
@@ -188,41 +251,7 @@ class Server:
 
     def _normalize(self, x: Any, transform: str, direction: str,
                    ny: Optional[int]) -> Tuple[np.ndarray, int, int, bool]:
-        """Validate one request payload; returns ``(x, nx, ny, double)``
-        with ``ny`` the LOGICAL real width (needed to key/construct the
-        plan — a spectral r2c payload alone cannot distinguish even/odd
-        ny, so inverse r2c callers may pass it; default assumes even)."""
-        if transform not in ("r2c", "c2c"):
-            raise ValueError(f"transform must be r2c|c2c, got {transform!r}")
-        if direction not in ("forward", "inverse"):
-            raise ValueError(
-                f"direction must be forward|inverse, got {direction!r}")
-        x = np.asarray(x)
-        if x.ndim != 2:
-            raise ValueError(
-                f"serve requests are single 2D images, got shape {x.shape} "
-                "(batching is the server's job — submit images "
-                "concurrently and they coalesce)")
-        complex_in = (transform == "c2c") or (direction == "inverse")
-        if complex_in != np.iscomplexobj(x):
-            raise ValueError(
-                f"{transform} {direction} expects a "
-                f"{'complex' if complex_in else 'real'} payload, got "
-                f"dtype {x.dtype}")
-        double = x.dtype in (np.float64, np.complex128)
-        if transform == "c2c" or direction == "forward":
-            nx_, ny_ = int(x.shape[0]), int(x.shape[1])
-            if ny is not None and int(ny) != ny_:
-                raise ValueError(f"ny {ny} disagrees with payload {x.shape}")
-            return x, nx_, ny_, double
-        # inverse r2c: payload is (nx, ny//2 + 1) spectral
-        nx_, nys = int(x.shape[0]), int(x.shape[1])
-        ny_ = int(ny) if ny is not None else 2 * (nys - 1)
-        if ny_ // 2 + 1 != nys:
-            raise ValueError(
-                f"ny {ny_} inconsistent with spectral payload {x.shape} "
-                f"(expects ny//2+1 == {nys})")
-        return x, nx_, ny_, double
+        return normalize_request(x, transform, direction, ny)
 
     def _breaker(self, key: str) -> CircuitBreaker:
         """Caller holds the lock. The map is BOUNDED like the plan cache
@@ -356,6 +385,12 @@ class Server:
             self._pending = keep
         obs.metrics.gauge("serve.queue_depth", len(self._pending))
         self._inflight = len(batch)
+        # Held until the worker clears it after execution (deliberately
+        # NO finally in _run — see the comment there) so close() can
+        # answer these futures too if the worker thread dies
+        # mid-execution — a popped batch must be as loss-proof as the
+        # queue it came from.
+        self._inflight_reqs = batch
         obs.event("serve.coalesce", key=head.base_key, n=len(batch),
                   traces=[r.trace_id for r in batch])
         return batch
@@ -381,11 +416,14 @@ class Server:
                     f"({type(err).__name__}: {err})"[:300],
                     name="serve.worker_error")
                 for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(err)
-            finally:
-                with self._lock:
-                    self._inflight = 0
+                    settle_future(r.future, exc=err)
+            # Deliberately NOT a finally: on a BaseException killing the
+            # thread itself (SystemExit et al.) the popped batch must
+            # STAY in _inflight_reqs so close() can answer its futures
+            # with ServerClosed instead of leaving them dangling.
+            with self._lock:
+                self._inflight = 0
+                self._inflight_reqs = []
 
     def _expire(self, req: _Request, detail: str) -> None:
         self._counts["deadline_expired"] += 1
@@ -395,7 +433,11 @@ class Server:
                   overrun_ms=round(over, 2), trace=req.trace_id)
         obs.event("serve.reply", trace=req.trace_id,
                   outcome="deadline_expired")
-        req.future.set_exception(DeadlineExceeded(
+        # settle_future (here and at every resolution site): close()
+        # answers a timed-out worker's popped batch with ServerClosed,
+        # and a SLOW worker finishing later must not InvalidStateError
+        # mid-delivery.
+        settle_future(req.future, exc=DeadlineExceeded(
             f"deadline exceeded by {over:.1f} ms ({detail})",
             detail=detail, overrun_ms=over))
 
@@ -479,7 +521,7 @@ class Server:
             with self._lock:
                 self._counts["rejected_circuit"] += len(batch)
             for r in batch:
-                r.future.set_exception(breaker.reject())
+                settle_future(r.future, exc=breaker.reject())
             return
         try:
             # The injected straggler (server:slow) ages the batch BEFORE
@@ -562,7 +604,7 @@ class Server:
             for r in alive:
                 obs.event("serve.reply", trace=r.trace_id,
                           outcome="error", error=type(err).__name__)
-                r.future.set_exception(err)
+                settle_future(r.future, exc=err)
             return
         ms = (time.perf_counter() - t0) * 1e3
         breaker.record_success()
@@ -607,7 +649,7 @@ class Server:
                                     (done_mono - r.submitted_at) * 1e3)
                 obs.event("serve.reply", trace=r.trace_id, outcome="ok",
                           coalesced_n=n)
-                r.future.set_result(np.array(res[i]))
+                settle_future(r.future, result=np.array(res[i]))
 
     # -- health / lifecycle ------------------------------------------------
 
@@ -673,19 +715,22 @@ class Server:
                            drain=drain, pending=pending)
             if not drain:
                 for r in self._pending:
-                    r.future.set_exception(
-                        ServerClosed("server closed before execution"))
+                    settle_future(r.future, exc=ServerClosed(
+                        "server closed before execution"))
                 self._pending.clear()
             self._cv.notify_all()
         self._worker.join(timeout_s)
         with self._cv:
             self._state = "stopped"
-            leftovers = self._pending
+            # Worker died/timed out: everything it left behind — queued
+            # requests AND the batch it had already popped — must be
+            # answered with a structured ServerClosed, never dropped.
+            leftovers = self._pending + self._inflight_reqs
             self._pending = []
-        for r in leftovers:  # worker died/timed out with work queued
-            if not r.future.done():
-                r.future.set_exception(
-                    ServerClosed("server stopped before execution"))
+            self._inflight_reqs = []
+        for r in leftovers:
+            settle_future(r.future, exc=ServerClosed(
+                "server stopped before execution"))
         obs.notice(f"serve: stopped ({self._counts['served']} served, "
                    f"{self._counts['shed']} shed)", name="serve.stop",
                    counters=dict(self._counts))

@@ -145,7 +145,8 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
                n_requests: int | None = None,
                shapes=((256, 256),), dtypes=("f32",),
                transforms=("r2c",), deadline_ms: float | None = None,
-               seed: int = 0, warmup: int = 1, stop=None) -> dict:
+               seed: int = 0, warmup: int = 1, stop=None,
+               tenants=None) -> dict:
     """Open-loop load generator: Poisson arrivals against a live
     :class:`~distributedfft_tpu.serve.server.Server`.
 
@@ -171,7 +172,13 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
     object) aborts the submission schedule early — the CLI's
     SIGTERM/SIGINT handler sets it so a long drive drains gracefully
     instead of running its full window; already-submitted requests are
-    still collected into the summary."""
+    still collected into the summary.
+
+    ``server`` may equally be a :class:`~..serve.fleet.Fleet` (same
+    submit surface). ``tenants`` (a sequence of names, fleet mode only)
+    mixes the traffic uniformly over tenant identities and adds a
+    ``by_tenant`` outcome/latency breakdown to the summary — the surface
+    the per-tenant fairness drills assert on."""
     import numpy as np
     if (duration_s is None) == (n_requests is None):
         raise ValueError("pass exactly one of duration_s / n_requests")
@@ -190,12 +197,14 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
     # Pre-build every coalescing bucket per cell (the rolling-restart
     # pattern) — but only when the plan cache can actually HOLD the
     # result: prewarming more plans than capacity just thrashes the LRU
-    # and leaves the measured window cold anyway.
+    # and leaves the measured window cold anyway. A Fleet has no single
+    # cache (each worker owns one); prewarm unconditionally there.
     from ..serve.plancache import bucket_for
     buckets_per_cell = bucket_for(server.max_coalesce,
                                   server.max_coalesce).bit_length()
-    full_prewarm = (len(cells) * buckets_per_cell
-                    <= server.cache.capacity)
+    cache_cap = getattr(getattr(server, "cache", None), "capacity", None)
+    full_prewarm = (cache_cap is None
+                    or len(cells) * buckets_per_cell <= cache_cap)
     for nx, ny, d, t in (cells if warmup else []):
         if full_prewarm:
             try:
@@ -227,15 +236,27 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
     mix = [cells[rng.integers(len(cells))] for _ in arrivals]
     pool = {c: [_payload(*c) for _ in range(4)] for c in cells}
     payloads = [pool[c][i % 4] for i, c in enumerate(mix)]
+    tenant_mix = ([str(tenants[rng.integers(len(tenants))])
+                   for _ in arrivals] if tenants else [None] * len(mix))
 
     import time as _time
-    outcomes = {"ok": 0, "shed": 0, "circuit_open": 0,
-                "deadline_expired": 0, "closed": 0, "failed": 0}
+    _OUTCOME0 = {"ok": 0, "shed": 0, "circuit_open": 0,
+                 "deadline_expired": 0, "closed": 0, "failed": 0}
+    outcomes = dict(_OUTCOME0)
+    by_tenant: dict = {str(t): {"outcomes": dict(_OUTCOME0),
+                                "latencies": []}
+                       for t in (tenants or [])}
+
+    def _tally(outcome, tenant):
+        outcomes[outcome] += 1
+        if tenant is not None:
+            by_tenant[tenant]["outcomes"][outcome] += 1
+
     latencies: list = []
     inflight: list = []
     t0 = _time.perf_counter()
     aborted = False
-    for at, cell, x in zip(arrivals, mix, payloads):
+    for at, cell, x, tn in zip(arrivals, mix, payloads, tenant_mix):
         if stop is not None and stop.is_set():
             aborted = True
             break
@@ -251,9 +272,12 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
             break
         sub = _time.perf_counter()
         try:
-            fut = server.submit(x, cell[3], deadline_ms=deadline_ms)
+            kw = {"deadline_ms": deadline_ms}
+            if tn is not None:
+                kw["tenant"] = tn
+            fut = server.submit(x, cell[3], **kw)
         except Exception as e:  # noqa: BLE001 — classify the rejection
-            outcomes[_classify(e)] += 1
+            _tally(_classify(e), tn)
             continue
         # End-to-end latency must stamp when the future RESOLVES (the
         # worker's set_result), not when this open-loop harness gets
@@ -262,25 +286,37 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
         fut.add_done_callback(
             lambda f, rec=rec: rec.__setitem__("done",
                                                _time.perf_counter()))
-        inflight.append((rec, fut))
-    for rec, fut in inflight:
+        inflight.append((rec, fut, tn))
+    for rec, fut, tn in inflight:
         try:
             fut.result()
         except Exception as e:  # noqa: BLE001
-            outcomes[_classify(e)] += 1
+            _tally(_classify(e), tn)
             continue
-        outcomes["ok"] += 1
+        _tally("ok", tn)
         # Future.set_result wakes result() waiters BEFORE running done
         # callbacks, so the stamp can lag a just-resolved future by a
         # hair — fall back to "now", which is within that same hair.
         done = rec.get("done") or _time.perf_counter()
         latencies.append((done - rec["sub"]) * 1e3)
+        if tn is not None:
+            by_tenant[tn]["latencies"].append(latencies[-1])
     wall_s = _time.perf_counter() - t0
     lat = np.asarray(latencies, dtype=np.float64)
     # offered = arrivals actually driven; an aborted (stop-signalled) run
     # offered only what it got through before the signal.
     offered = sum(outcomes.values())
-    return {
+    tenant_block = {}
+    for t, rec in by_tenant.items():
+        tl = np.asarray(rec["latencies"], dtype=np.float64)
+        tenant_block[t] = {
+            "outcomes": rec["outcomes"],
+            "p50_ms": round(float(np.percentile(tl, 50)), 3)
+            if len(tl) else None,
+            "p99_ms": round(float(np.percentile(tl, 99)), 3)
+            if len(tl) else None,
+        }
+    return ({"by_tenant": tenant_block} if tenant_block else {}) | {
         "offered": offered,
         "aborted": aborted,
         "offered_rate_hz": round(offered / wall_s, 3),

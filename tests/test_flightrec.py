@@ -113,6 +113,30 @@ def test_trigger_cooldown_rate_limits_per_kind(monkeypatch):
     assert flightrec.trigger("circuit_open", "other kind") is not None
 
 
+def test_fleet_triggers_in_vocabulary(monkeypatch):
+    """ISSUE 13: the fleet's trigger kinds are first-class — known to
+    the trigger table (NOT coerced to manual), schema-valid dumps, and
+    independently cooled down per kind like every other trigger."""
+    monkeypatch.setenv(flightrec.ENV_COOLDOWN, "3600")
+    assert "worker_death" in flightrec.TRIGGERS
+    assert "scale_decision" in flightrec.TRIGGERS
+    flightrec.record("event", "fleet.worker_death", worker="worker-1")
+    path = flightrec.trigger("worker_death", "worker-1: pipe closed",
+                             worker="worker-1", moved=3)
+    hdr = json.loads(open(path, encoding="utf-8").readline())
+    assert hdr["trigger"] == "worker_death"
+    assert hdr["attrs"] == {"worker": "worker-1", "moved": 3}
+    assert flightrec.validate_dump_file(path) == 1
+    # per-kind cooldown: a worker-death storm is rate-limited without
+    # suppressing the (independent) scale-decision dump
+    assert flightrec.trigger("worker_death", "storm") is None
+    path2 = flightrec.trigger("scale_decision", "up 2 -> 3")
+    assert path2 is not None
+    assert json.loads(open(path2, encoding="utf-8").readline())[
+        "trigger"] == "scale_decision"
+    assert flightrec.validate_dump_file(path2) >= 1
+
+
 def test_unknown_trigger_coerces_to_manual():
     path = flightrec.trigger("not-a-trigger", "coerced")
     hdr = json.loads(open(path, encoding="utf-8").readline())

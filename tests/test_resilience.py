@@ -110,6 +110,60 @@ def test_multi_fault_spec_grammar(monkeypatch):
     assert inject._spec_of("autotune") is None
 
 
+def test_worker_fault_spec_grammar(monkeypatch):
+    """ISSUE 13: the fleet's host-side worker faults parse under the
+    same strict grammar — ``worker:crash[:K]`` / ``worker:hang[:MS]``
+    with ``@seed=I`` selecting the victim worker INDEX — and compose
+    with the wire/server kinds in one comma-separated spec."""
+    from distributedfft_tpu.resilience.inject import parse_fault_specs
+    s = parse_fault_spec("worker:crash:3@seed=1")
+    assert (s.kind, s.mode, s.param, s.seed) == ("worker", "crash", 3, 1)
+    assert parse_fault_spec(str(s)) == s  # round-trips
+    assert parse_fault_spec("worker:crash").param is None  # K defaults 1
+    h = parse_fault_spec("worker:hang:500")
+    assert (h.mode, h.param) == ("hang", 500.0)
+    # comma-composable with the existing kinds
+    specs = parse_fault_specs("wire:bitflip,worker:crash:2@seed=1")
+    assert [sp.kind for sp in specs] == ["wire", "worker"]
+    for bad in ("worker", "worker:oops", "worker:crash:2:3",
+                "worker:crash,worker:hang"):  # one fault per kind
+        with pytest.raises(ValueError):
+            (parse_fault_specs if "," in bad else parse_fault_spec)(bad)
+
+
+def test_worker_fault_hooks_gate_on_victim_and_generation(monkeypatch):
+    """The crash/hang hooks fire only in the victim worker index and
+    only in its FIRST incarnation: a non-victim index, a respawned
+    generation, and an unset spec are all exact no-ops (the replacement
+    worker must come back clean — no crash loop)."""
+    import time as _time
+
+    # unset: no-ops
+    assert inject.maybe_crash_worker(0, 0) is None
+    assert inject.maybe_hang_worker(0, 0) is None
+
+    monkeypatch.setenv(inject.ENV_VAR, "worker:hang:50@seed=1")
+    t0 = _time.monotonic()
+    inject.maybe_hang_worker(0, 0)   # wrong index: no sleep
+    inject.maybe_hang_worker(1, 1)   # respawned generation: no sleep
+    assert _time.monotonic() - t0 < 0.04
+    inject.maybe_hang_worker(1, 0)   # the victim, generation 0: sleeps
+    assert _time.monotonic() - t0 >= 0.05
+    assert obs.metrics.counter_value("inject.worker_hangs") == 1
+
+    # crash: gating paths must return without exiting the process
+    # (the actual os._exit path is pinned end-to-end by the fleet
+    # chaos test — it cannot run in-process by construction)
+    monkeypatch.setenv(inject.ENV_VAR, "worker:crash:99@seed=1")
+    inject._WORKER_REQS[0] = 0
+    inject.maybe_crash_worker(0, 0)  # wrong index
+    inject.maybe_crash_worker(1, 1)  # respawned generation
+    assert inject._WORKER_REQS[0] == 0
+    inject.maybe_crash_worker(1, 0)  # victim: counts toward K=99
+    assert inject._WORKER_REQS[0] == 1
+    inject._WORKER_REQS[0] = 0
+
+
 def test_server_slow_injector(monkeypatch):
     monkeypatch.setenv(inject.ENV_VAR, "server:slow:60")
     t0 = time.perf_counter()

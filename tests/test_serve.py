@@ -526,6 +526,37 @@ def test_graceful_drain_and_event_log(devices, tmp_path):
         assert want in names, f"missing {want} in {sorted(names)}"
 
 
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_close_after_worker_thread_death_answers_everything(devices,
+                                                            monkeypatch):
+    """ISSUE 13 regression: if the serving thread DIES (an escape the
+    batch guard cannot catch — SystemExit stands in for a fatal
+    interpreter-level failure), ``close(drain=True)`` must answer every
+    leftover with a structured ``ServerClosed`` — both the requests
+    still queued AND the batch the dead thread had already popped.
+    Nothing may dangle (a dangling future hangs its client forever)."""
+    with Server() as s:
+        x = _img((16, 16))
+        s.request(x)  # warm
+        orig = Server._execute
+
+        def lethal(self, batch):
+            monkeypatch.setattr(Server, "_execute", orig)
+            raise SystemExit(1)  # kills the worker thread itself
+
+        monkeypatch.setattr(Server, "_execute", lethal)
+        f1 = s.submit(x)                       # popped by the worker
+        time.sleep(0.1)                        # thread takes it and dies
+        f2 = s.submit(_img((16, 16), seed=1))  # stays queued forever
+        f3 = s.submit(_img((16, 16), seed=2))
+        s.close(drain=True, timeout_s=1.0)
+        for f in (f1, f2, f3):
+            with pytest.raises(ServerClosed):
+                f.result(5)
+    assert s.health()["status"] == "stopped"
+
+
 def test_close_without_drain_rejects_queued(devices, monkeypatch):
     with Server() as s:
         x = _img((16, 16))

@@ -279,12 +279,13 @@ def _lint_host_only_jnp(path: str, tree: ast.Module,
 LOCK_HELPER = "_advisory_lock"
 
 # Modules whose os.replace writes must stay under the flock helper: the
-# wisdom store (the rule's origin), plus every module of the serve/ and
-# solvers/ packages — long-lived processes persisting shared state
-# (plan-cache spills, health snapshots, solver checkpoints) re-open the
-# exact read-merge-replace race the helper closes.
+# wisdom store (the rule's origin), plus every module of the serve/,
+# solvers/ and persist/ packages — long-lived processes persisting
+# shared state (plan-cache spills, health snapshots, solver checkpoint
+# generations) re-open the exact read-merge-replace race the helper
+# closes.
 LOCKED_REPLACE_MODULES = (os.path.join("utils", "wisdom.py"),)
-LOCKED_REPLACE_PACKAGES = ("serve", "solvers")
+LOCKED_REPLACE_PACKAGES = ("serve", "solvers", "persist")
 
 
 def _replace_lock_applies(path: str) -> bool:

@@ -25,7 +25,13 @@ config + shape:
   time, and the tracked ``roofline_fraction`` for this size from the
   committed BENCH_DETAILS.json "roofline" block (ISSUE 10's gate);
 * overlap schedule for ring-rendered exchanges (Ring / RingOverlap):
-  blocks, revolving buffers, and the wire bytes in flight per device.
+  blocks, revolving buffers, and the wire bytes in flight per device;
+* checkpoint registry (``--checkpoint-dir`` / ``$DFFT_CKPT_DIR``): the
+  persist store's generations (step, age, validity), the
+  plan-fingerprint match verdict for THIS plan — from the same
+  ``CheckpointStore.describe`` the restore path uses, so explain cannot
+  disagree with restore — and the next scheduled write under the
+  resolved ``CheckpointPolicy``.
 
 Examples::
 
@@ -86,6 +92,14 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=("off", "check", "enforce"),
                     help="explain the plan's resilience posture under this "
                          "guard mode (default: $DFFT_GUARDS -> off)")
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="explain the persist/ checkpoint store here "
+                         "(default $DFFT_CKPT_DIR): generations, age, "
+                         "step, fingerprint-match verdict vs THIS plan")
+    ap.add_argument("--checkpoint-policy", default=None,
+                    metavar="steps:N[,secs:T][,drain:on|off]",
+                    help="resolve the checkpoint cadence shown in the "
+                         "checkpoint: section (default $DFFT_CKPT_POLICY)")
     ap.add_argument("--fft-backend", default="xla")
     ap.add_argument("--double_prec", "-d", action="store_true")
     ap.add_argument("--c2c", action="store_true",
@@ -272,6 +286,53 @@ def _resilience_lines(plan, cfg, prov) -> list:
                     f"{rec.get('demoted_at', '?')} — "
                     f"{rec.get('demoted_reason', '')[:80]} ({verdict})")
     lines += stamps if stamps else ["  demotion stamps: none"]
+    return lines
+
+
+def _checkpoint_lines(args, plan) -> list:
+    """The ``checkpoint:`` section (ISSUE 14): the persist store's
+    generation registry, the plan-fingerprint verdict for THIS plan, and
+    the next scheduled write under the resolved policy. Built from the
+    SAME ``CheckpointStore.describe``/``fingerprint_mismatch`` surface
+    the restore path runs — explain cannot disagree with restore about
+    which generation would load or why it would refuse."""
+    import os as _os
+    import time as _time
+
+    from .. import persist
+    ckdir = args.checkpoint_dir or _os.environ.get(persist.ENV_DIR, "")
+    if not ckdir:
+        return ["  store: none configured (--checkpoint-dir / "
+                "$DFFT_CKPT_DIR unset)"]
+    store = persist.CheckpointStore(ckdir)
+    fp = persist.plan_fingerprint(plan)
+    d = store.describe(expect_fingerprint=fp)
+    lines = [f"  store: {d['directory']} "
+             f"({len(persist.GENERATION_SLOTS)} generation slots)"]
+    for g in d["generations"]:
+        name = _os.path.basename(g["path"])
+        if not g["exists"]:
+            lines.append(f"  {name}: absent")
+        elif g["valid"]:
+            age = ("age unknown" if g["age_s"] is None
+                   else f"age {g['age_s']:.1f} s")
+            lines.append(f"  {name}: step {g['step']}, {age}, valid")
+        else:
+            lines.append(f"  {name}: INVALID ({g['reason']}) — restore "
+                         "skips it (one-generation fallback)")
+    lines.append(f"  plan fingerprint: {d['fingerprint_verdict']}")
+    try:
+        policy = persist.CheckpointPolicy.parse(
+            args.checkpoint_policy
+            or _os.environ.get(persist.ENV_POLICY))
+    except ValueError as e:
+        return lines + [f"  policy: INVALID spec ({e})"]
+    latest = d["latest"]
+    step = latest["step"] if latest else 0
+    age = latest["age_s"] if latest and latest["age_s"] is not None else 0.0
+    now = _time.monotonic()
+    lines.append(f"  policy: {policy} — next write "
+                 + policy.describe_next(step, step, now - age, now))
     return lines
 
 
@@ -648,6 +709,9 @@ def main(argv=None) -> int:
 
         out.append("serve:")
         out.extend(_serve_lines(args, kind, plan, cfg))
+
+        out.append("checkpoint:")
+        out.extend(_checkpoint_lines(args, plan))
 
         if not args.no_compile:
             out.append("hlo census (forward program, compiled, "

@@ -28,6 +28,13 @@ trigger                fired by
 ``scale_decision``     the fleet's worker-count controller acting on the
                        ``/metrics`` signals (``serve/fleet.py``) — the
                        auditable record of WHY capacity changed
+``checkpoint_restore_failure``  the persist layer skipping or refusing
+                       a checkpoint generation (``persist/checkpoint.py``
+                       ``CheckpointStore.load``: corruption fallback,
+                       fingerprint mismatch, or zero loadable
+                       generations) — the dump carries the writes and
+                       injected faults of the run that left the store in
+                       that state
 ``signal``             SIGUSR2 (``install_signal_handler``; the live-
                        debugging surface: kill -USR2 a stuck server)
 ``manual``             programmatic ``dump()``
@@ -66,8 +73,8 @@ ENV_COOLDOWN = "DFFT_FLIGHTREC_COOLDOWN_S"
 DEFAULT_CAPACITY = 2048
 
 TRIGGERS = ("guard_violation", "circuit_open", "fallback_demotion",
-            "shed_burst", "worker_death", "scale_decision", "signal",
-            "manual")
+            "shed_burst", "worker_death", "scale_decision",
+            "checkpoint_restore_failure", "signal", "manual")
 
 _LOCK = threading.Lock()
 _RING: Deque[Dict[str, Any]] = collections.deque(maxlen=DEFAULT_CAPACITY)

@@ -1,0 +1,604 @@
+"""Crash-consistent checkpoint format + two-generation store.
+
+ROADMAP item 5(c): a turbulence run resident inside ``dfft-serve`` is
+only production-grade if a SIGTERM, an OOM-kill or a fleet scale-down
+cannot destroy hours of simulation progress. PR 8's drain and PR 13's
+worker-death recovery protect in-flight *requests*; this module protects
+long-lived *state* — the spectral fields, the step counter, and the
+plan/wisdom provenance that makes a resumed run reproducible.
+
+Checkpoint file format (one generation = one self-describing file)::
+
+    bytes  0..7    magic  b"DFFTCKP1"
+    bytes  8..11   header length H (u32 LE)
+    bytes 12..15   CRC32C of the H header bytes (u32 LE)
+    bytes 16..16+H header JSON (utf-8)
+    then the raw C-contiguous array payloads, concatenated
+
+The header carries ``version`` (schema), the solver step counter, ``dt``,
+simulated time, the RNG/forcing phase, the **plan fingerprint**
+(``resilience.guards.fingerprint`` — family, shape, rendering, wire,
+backend), **wisdom provenance** (store path + on-disk schema version at
+save time), free-form ``meta``, and one section record per array
+(``name``/``dtype``/``shape``/``sharding``/``offset``/``nbytes``/
+``crc32c``). Every section is independently CRC32C-checksummed, so a
+single flipped byte anywhere is detected before ANY bytes reach a device
+array — a corrupt checkpoint can cost a generation, never a garbage
+restore.
+
+Crash consistency is the wisdom-store discipline (``utils/wisdom.py``):
+the blob is written to a temp file in the target directory, ``fsync``'d,
+then ``os.replace``'d into its generation slot under the advisory flock
+(``_advisory_lock`` — srclint's replace-under-lock rule covers this
+package), and the directory entry is fsync'd; a torn write can only tear
+the temp file, never a slot. The :class:`CheckpointStore` rotates TWO
+generation slots (``ckpt-a.dfft`` / ``ckpt-b.dfft``) and always
+overwrites the OLDER one, so even a fault that lands a corrupt newest
+generation (``$DFFT_FAULT_SPEC=checkpoint:torn|corrupt|stale``,
+``resilience/inject.py``) leaves one loadable checkpoint — ``load``
+falls back exactly one generation (``persist.generation_fallbacks``
+metric + ``checkpoint_restore_failure`` flight-recorder trigger) and
+refuses with a structured error when both are bad.
+
+Restore contract: ``load`` validates checksums and schema version,
+REFUSES a plan whose fingerprint disagrees with the checkpoint's
+(:class:`CheckpointMismatch` — a mismatched plan is a configuration
+error, not corruption, so no generation fallback), and ``state.py``
+re-places the arrays into the *current* plan's shardings so a resumed
+run continues **bit-exactly** (the acceptance experiment: SIGTERM at
+step k, resume, run to n, compare bit-for-bit with an uninterrupted
+n-step run — ``tests/test_persist.py`` + the CI ``resume`` chaos
+scenario).
+
+Everything here is host-side numpy + file I/O: the persist layer adds
+ZERO traced ops to any compiled program (the dfft-verify fingerprint
+pins cover it by construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..resilience import inject
+from ..utils.wisdom import _advisory_lock
+
+MAGIC = b"DFFTCKP1"
+CHECKPOINT_VERSION = 1
+_HEADER_FIXED = len(MAGIC) + 8  # magic + u32 header_len + u32 header_crc
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) — the checksum the format stamps on every section.
+# ---------------------------------------------------------------------------
+
+_CRC32C_POLY = 0x82F63B78
+
+
+def _build_table() -> List[int]:
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_TABLE = _build_table()
+
+try:  # hardware-accelerated wheels, when the image happens to carry one
+    from crc32c import crc32c as _crc32c_hw  # type: ignore[import-not-found]
+except ImportError:  # pure-python fallback (the common case here)
+    _crc32c_hw = None
+
+
+def crc32c(data: Any, crc: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data`` (bytes-like), continuing from
+    ``crc`` — the polynomial iSCSI/ext4 use, table-driven pure python
+    with an optional accelerated backend. Known answer:
+    ``crc32c(b"123456789") == 0xE3069283``.
+
+    Performance note: the pure-python loop runs a few MB/s — fine for
+    the in-tree solver states (KBs–MBs per generation) but a real cost
+    per write/validate on 100-MB-class states; deployments at that
+    scale should install a ``crc32c`` wheel (picked up automatically
+    above, C speed, same answers). The checksum stays CRC32C — the
+    on-disk format pins the polynomial, and swapping to zlib's CRC32
+    would silently invalidate every existing generation."""
+    buf = memoryview(data).cast("B") if not isinstance(data, (bytes, bytearray)) \
+        else data
+    if _crc32c_hw is not None:
+        return int(_crc32c_hw(bytes(buf), crc))
+    c = crc ^ 0xFFFFFFFF
+    table = _TABLE
+    for b in buf:
+        c = (c >> 8) ^ table[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# structured failures
+# ---------------------------------------------------------------------------
+
+class CheckpointError(RuntimeError):
+    """Base of every structured persist failure."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """One checkpoint file failed validation (bad magic, unsupported
+    schema version, short file, or a CRC32C mismatch); carries where and
+    why so the generation-fallback path can report what it skipped."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+class CheckpointMissing(CheckpointError):
+    """No generation file exists at all — a FRESH simulation, not a
+    failure (residents start from the initial condition on this)."""
+
+    def __init__(self, directory: str):
+        super().__init__(f"no checkpoint generations in {directory}")
+        self.directory = directory
+
+
+class CheckpointMismatch(CheckpointError):
+    """The checkpoint was written by a DIFFERENT plan than the one asked
+    to resume (fingerprint disagreement) — a configuration error, never
+    auto-resolved: loading spectral state into a differently-rendered
+    plan would silently change the simulation."""
+
+    def __init__(self, path: str, diffs: Dict[str, Tuple[Any, Any]]):
+        detail = ", ".join(f"{k}: checkpoint={a!r} plan={b!r}"
+                           for k, (a, b) in sorted(diffs.items()))
+        super().__init__(f"checkpoint {path} fingerprint mismatch "
+                         f"({detail})")
+        self.path = path
+        self.diffs = diffs
+
+
+class CheckpointUnusable(CheckpointError):
+    """EVERY generation failed validation — the store has zero loadable
+    checkpoints; carries the per-generation reasons."""
+
+    def __init__(self, directory: str, reasons: Dict[str, str]):
+        detail = "; ".join(f"{os.path.basename(p)}: {r}"
+                           for p, r in sorted(reasons.items()))
+        super().__init__(
+            f"no loadable checkpoint in {directory} ({detail})")
+        self.directory = directory
+        self.reasons = reasons
+
+
+# ---------------------------------------------------------------------------
+# the state a checkpoint carries
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SimState:
+    """One checkpointable simulation state: named host arrays plus the
+    scalar/bookkeeping fields the header records. ``rng`` is the
+    RNG/forcing phase (JSON-able dict; e.g. a forcing seed + draw
+    counter), ``plan_fingerprint`` the identity restore validates, and
+    ``wisdom`` the provenance of the autotuned choices the plan was
+    built from."""
+
+    arrays: Dict[str, np.ndarray]
+    step: int = 0
+    dt: float = 0.0
+    sim_time: float = 0.0
+    rng: Optional[Dict[str, Any]] = None
+    plan_fingerprint: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    wisdom: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    written_at: Optional[float] = None  # stamped by write_checkpoint
+
+
+# ---------------------------------------------------------------------------
+# single-file writer / reader
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(directory: str) -> None:
+    """Best-effort fsync of the directory entry (the rename itself must
+    survive the crash, not only the file bytes)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_checkpoint(path: str, state: SimState) -> int:
+    """Serialize ``state`` to ``path`` crash-consistently (temp + fsync +
+    ``os.replace`` under the advisory flock, directory fsync'd); returns
+    the bytes written. Raises ``OSError``/``TypeError`` on an unwritable
+    target or un-serializable state — persistence failures are loud, a
+    silently-lost checkpoint is the failure mode this module exists to
+    remove."""
+    sections: List[Dict[str, Any]] = []
+    payloads: List[bytes] = []
+    offset = 0
+    for name in sorted(state.arrays):
+        arr = np.ascontiguousarray(state.arrays[name])
+        raw = arr.tobytes()
+        sections.append({
+            "name": name, "dtype": arr.dtype.str,
+            "shape": list(arr.shape), "offset": offset,
+            "nbytes": len(raw), "crc32c": crc32c(raw),
+        })
+        payloads.append(raw)
+        offset += len(raw)
+    written_at = time.time()
+    header = {
+        "version": CHECKPOINT_VERSION,
+        "step": int(state.step),
+        "dt": float(state.dt),
+        "sim_time": float(state.sim_time),
+        "rng": state.rng,
+        "plan_fingerprint": state.plan_fingerprint,
+        "wisdom": state.wisdom,
+        "meta": state.meta,
+        "written_at": written_at,
+        "arrays": sections,
+    }
+    hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+    blob = (MAGIC + len(hdr).to_bytes(4, "little")
+            + crc32c(hdr).to_bytes(4, "little") + hdr + b"".join(payloads))
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    with obs.span("persist.write", path=path, step=int(state.step),
+                  nbytes=len(blob)), _advisory_lock(path):
+        fd, tmp = tempfile.mkstemp(prefix=".ckpt.", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        _fsync_dir(d)
+    state.written_at = written_at
+    # The fault injectors tear/corrupt/stale-stamp the LANDED file —
+    # simulating a write the filesystem lost mid-rename or bitrot the
+    # disk returned — so the restore path's validation is exercised
+    # against exactly what it would see in the field.
+    inject.maybe_taint_checkpoint(path)
+    obs.metrics.inc("persist.writes")
+    obs.metrics.inc("persist.bytes_written", len(blob))
+    obs.event("persist.checkpoint", path=path, step=int(state.step),
+              nbytes=len(blob), arrays=len(sections))
+    return len(blob)
+
+
+def _read_validated(path: str, header_only: bool = False
+                    ) -> Tuple[Dict[str, Any], Optional[bytes]]:
+    """Read + validate one checkpoint file; returns ``(header,
+    payload_bytes)`` (payload None when ``header_only``). Raises
+    :class:`CheckpointCorrupt` on ANY defect — validation happens before
+    a single payload byte is interpreted."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(_HEADER_FIXED)
+            if len(head) < _HEADER_FIXED:
+                raise CheckpointCorrupt(path, "short file (no header)")
+            if head[:len(MAGIC)] != MAGIC:
+                raise CheckpointCorrupt(
+                    path, f"bad magic {head[:len(MAGIC)]!r}")
+            hlen = int.from_bytes(head[len(MAGIC):len(MAGIC) + 4], "little")
+            hcrc = int.from_bytes(head[len(MAGIC) + 4:], "little")
+            hdr_bytes = f.read(hlen)
+            if len(hdr_bytes) != hlen:
+                raise CheckpointCorrupt(path, "truncated header")
+            if crc32c(hdr_bytes) != hcrc:
+                raise CheckpointCorrupt(path, "header CRC32C mismatch")
+            try:
+                header = json.loads(hdr_bytes.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                raise CheckpointCorrupt(path,
+                                        f"unparsable header ({e})") from e
+            version = header.get("version")
+            if version != CHECKPOINT_VERSION:
+                raise CheckpointCorrupt(
+                    path, f"unsupported schema version {version!r} "
+                          f"(this build reads {CHECKPOINT_VERSION})")
+            if not isinstance(header.get("arrays"), list):
+                raise CheckpointCorrupt(path, "header carries no array "
+                                              "section table")
+            if header_only:
+                return header, None
+            payload = f.read()
+    except OSError as e:
+        raise CheckpointCorrupt(path, f"unreadable ({e})") from e
+    for sec in header["arrays"]:
+        off, n = int(sec["offset"]), int(sec["nbytes"])
+        if off + n > len(payload):
+            raise CheckpointCorrupt(
+                path, f"torn payload: section {sec['name']!r} wants "
+                      f"[{off}:{off + n}] of {len(payload)} byte(s)")
+        if crc32c(payload[off:off + n]) != int(sec["crc32c"]):
+            raise CheckpointCorrupt(
+                path, f"section {sec['name']!r} CRC32C mismatch")
+    return header, payload
+
+
+def read_checkpoint(path: str) -> SimState:
+    """Load + fully validate one checkpoint file into a
+    :class:`SimState` (host numpy arrays). Raises
+    :class:`CheckpointCorrupt` on any defect; no bytes are interpreted
+    as array data until every section checksum has passed."""
+    header, payload = _read_validated(path)
+    assert payload is not None
+    arrays: Dict[str, np.ndarray] = {}
+    for sec in header["arrays"]:
+        off, n = int(sec["offset"]), int(sec["nbytes"])
+        arr = np.frombuffer(payload[off:off + n],
+                            dtype=np.dtype(sec["dtype"]))
+        arrays[sec["name"]] = arr.reshape(tuple(sec["shape"])).copy()
+    return SimState(
+        arrays=arrays, step=int(header["step"]), dt=float(header["dt"]),
+        sim_time=float(header.get("sim_time", 0.0)),
+        rng=header.get("rng"),
+        plan_fingerprint=dict(header.get("plan_fingerprint") or {}),
+        wisdom=dict(header.get("wisdom") or {}),
+        meta=dict(header.get("meta") or {}),
+        written_at=header.get("written_at"))
+
+
+# ---------------------------------------------------------------------------
+# two-generation store
+# ---------------------------------------------------------------------------
+
+GENERATION_SLOTS = ("ckpt-a.dfft", "ckpt-b.dfft")
+
+
+def fingerprint_mismatch(stored: Dict[str, Any],
+                         current: Dict[str, Any]
+                         ) -> Dict[str, Tuple[Any, Any]]:
+    """Field-wise diff of two plan fingerprints (empty dict = match).
+    The RESTORE path and ``dfft-explain``'s ``checkpoint:`` section both
+    call this — one comparison, so explain cannot disagree with
+    restore."""
+    diffs: Dict[str, Tuple[Any, Any]] = {}
+    for k in set(stored) | set(current):
+        if stored.get(k) != current.get(k):
+            diffs[k] = (stored.get(k), current.get(k))
+    return diffs
+
+
+class CheckpointStore:
+    """Two-generation rotating checkpoint store over one directory.
+
+    ``save`` always overwrites the OLDER (or invalid) slot, so the
+    newest valid generation is never the write target — a torn write can
+    cost at most the generation being written. ``load`` returns the
+    newest valid generation, falling back exactly one generation on
+    corruption; :meth:`describe` is the registry surface
+    ``dfft-explain`` and serve ``health()`` read, built from the SAME
+    validation the load path runs."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(os.path.expanduser(str(directory)))
+
+    def _slot_paths(self) -> List[str]:
+        return [os.path.join(self.directory, s) for s in GENERATION_SLOTS]
+
+    def _scan(self, full: bool = False) -> List[Dict[str, Any]]:
+        """Validate every slot: one record per slot with ``path``/
+        ``exists``/``valid``/``step``/``written_at``/``reason``.
+        Default is header-only (cheap — header CRC; the load path
+        re-validates its chosen generation in full anyway); ``full``
+        additionally runs every SECTION checksum, so a verdict built on
+        it (``describe``) cannot call a payload-corrupt generation
+        valid when restore would skip it."""
+        out: List[Dict[str, Any]] = []
+        for path in self._slot_paths():
+            rec: Dict[str, Any] = {"path": path,
+                                   "exists": os.path.exists(path),
+                                   "valid": False, "step": None,
+                                   "written_at": None, "reason": None,
+                                   "mtime": None}
+            if rec["exists"]:
+                try:
+                    rec["mtime"] = os.path.getmtime(path)
+                except OSError:
+                    pass
+                try:
+                    header, _ = _read_validated(path, header_only=not full)
+                    rec.update(valid=True, step=int(header["step"]),
+                               written_at=header.get("written_at"),
+                               fingerprint=dict(
+                                   header.get("plan_fingerprint") or {}))
+                except CheckpointCorrupt as e:
+                    rec["reason"] = e.reason
+            else:
+                rec["reason"] = "absent"
+            out.append(rec)
+        return out
+
+    def _write_target(self) -> str:
+        """The slot ``save`` must overwrite: an absent/invalid slot
+        first, else the OLDER valid generation — the newest
+        fully-loadable checkpoint is never the write target. FULL
+        validation (section checksums, not just the header): a
+        payload-torn newest generation must read as the invalid slot
+        here, or save would overwrite the only generation ``load``
+        could actually restore."""
+        scan = self._scan(full=True)
+        for rec in scan:
+            if not rec["valid"]:
+                return str(rec["path"])
+        oldest = min(scan, key=lambda r: (r["step"], r["written_at"] or 0))
+        return str(oldest["path"])
+
+    def save(self, state: SimState) -> str:
+        """Write ``state`` into the rotation; returns the generation
+        path written."""
+        path = self._write_target()
+        write_checkpoint(path, state)
+        obs.metrics.gauge("persist.last_checkpoint_age_s", 0.0)
+        return path
+
+    def load(self, expect_fingerprint: Optional[Dict[str, Any]] = None
+             ) -> SimState:
+        """The newest fully-valid generation, newest-step-first with
+        exactly-one-generation fallback on corruption
+        (``persist.generation_fallbacks`` + the
+        ``checkpoint_restore_failure`` flight-recorder trigger document
+        every skipped generation). ``expect_fingerprint`` (the CURRENT
+        plan's ``persist.plan_fingerprint``) refuses a mismatched
+        checkpoint with :class:`CheckpointMismatch` — no fallback: a
+        fingerprint disagreement is configuration, not corruption.
+        Raises :class:`CheckpointMissing` when no generation file
+        exists, :class:`CheckpointUnusable` when all that exist fail
+        validation."""
+        from ..obs import flightrec
+        scan = [r for r in self._scan() if r["exists"]]
+        if not scan:
+            raise CheckpointMissing(self.directory)
+
+        def _fell_back(path: str, reason: str) -> None:
+            obs.metrics.inc("persist.generation_fallbacks")
+            obs.notice(
+                f"persist: generation {os.path.basename(path)} invalid "
+                f"({reason}); falling back one generation",
+                name="persist.generation_fallback", path=path)
+            flightrec.trigger("checkpoint_restore_failure",
+                              f"generation fallback: {reason}", path=path)
+
+        # Candidates: VALID headers ordered by highest step — the same
+        # choice describe()/health advertise as "latest" (mtime is wall
+        # clock and survives neither cp nor a clock step, so it must
+        # not pick the restore target). Header-invalid generations are
+        # recorded up front; one NEWER (by write time) than the best
+        # valid candidate means the latest write was lost — an honest
+        # generation fallback, accounted before the older state loads.
+        order = sorted((r for r in scan if r["valid"]),
+                       key=lambda r: (r["step"], r["mtime"] or 0),
+                       reverse=True)
+        reasons: Dict[str, str] = {}
+        for rec in scan:
+            if not rec["valid"]:
+                path = str(rec["path"])
+                reasons[path] = str(rec["reason"])
+                obs.event("persist.generation_skipped", path=path,
+                          reason=str(rec["reason"]))
+                if order and (rec["mtime"] or 0) >= \
+                        (order[0]["mtime"] or 0):
+                    _fell_back(path, str(rec["reason"]))
+        for i, rec in enumerate(order):
+            path = str(rec["path"])
+            try:
+                state = read_checkpoint(path)  # full section CRC pass
+            except CheckpointCorrupt as e:
+                reasons[path] = e.reason
+                obs.event("persist.generation_skipped", path=path,
+                          reason=e.reason)
+                if i + 1 < len(order):
+                    _fell_back(path, e.reason)
+                continue
+            if expect_fingerprint is not None:
+                # The stored fingerprint participates even when EMPTY
+                # (a hand-rolled writer that skipped capture): restore
+                # and describe() must render the same verdict.
+                diffs = fingerprint_mismatch(state.plan_fingerprint,
+                                             expect_fingerprint)
+                if diffs:
+                    obs.metrics.inc("persist.restore_failures")
+                    flightrec.trigger(
+                        "checkpoint_restore_failure",
+                        f"fingerprint mismatch: {sorted(diffs)}",
+                        path=path)
+                    raise CheckpointMismatch(path, diffs)
+            self.touch_age_gauge(state.written_at)
+            obs.metrics.inc("persist.restores")
+            obs.event("persist.restore", path=path, step=state.step,
+                      fallbacks=len(reasons))
+            return state
+        obs.metrics.inc("persist.restore_failures")
+        flightrec.trigger("checkpoint_restore_failure",
+                          "all generations unusable",
+                          directory=self.directory)
+        raise CheckpointUnusable(self.directory, reasons)
+
+    def touch_age_gauge(self, written_at: Optional[float] = None) -> None:
+        """Refresh ``persist.last_checkpoint_age_s`` from the newest
+        valid generation (or an explicit stamp) — serve ``health()``
+        calls this so the scrape surface carries a live age."""
+        if written_at is None:
+            valid = [r for r in self._scan() if r["valid"]
+                     and r["written_at"] is not None]
+            if not valid:
+                return
+            written_at = max(float(r["written_at"]) for r in valid)
+        obs.metrics.gauge("persist.last_checkpoint_age_s",
+                          round(max(0.0, time.time() - float(written_at)), 3))
+
+    def describe(self, expect_fingerprint: Optional[Dict[str, Any]] = None,
+                 full: bool = True) -> Dict[str, Any]:
+        """The registry ``dfft-explain``'s ``checkpoint:`` section and
+        serve ``health()`` read: per-slot validity/step/age plus the
+        verdict of what :meth:`load` would do for
+        ``expect_fingerprint`` — computed by the SAME fingerprint
+        comparison the restore path uses, over a FULL (every section
+        checksum) validation pass by default, so a payload-corrupt
+        generation reads invalid here exactly as restore will treat it.
+        ``full=False`` is the cheap header-only variant for hot
+        liveness surfaces (the resident's heartbeat-cadence
+        ``status()``) where re-reading multi-MB states per pong would
+        stall the very reply the death detector times."""
+        now = time.time()
+        scan = self._scan(full=full)
+        gens = []
+        for rec in scan:
+            gens.append({
+                "path": str(rec["path"]), "exists": rec["exists"],
+                "valid": rec["valid"], "step": rec["step"],
+                "age_s": (round(now - float(rec["written_at"]), 3)
+                          if rec.get("written_at") else None),
+                "reason": rec["reason"],
+            })
+        valid = [r for r in scan if r["valid"]]
+        latest = max(valid, key=lambda r: (r["step"], r["written_at"] or 0),
+                     default=None)
+        verdict = "no checkpoint (fresh start)"
+        latest_out: Optional[Dict[str, Any]] = None
+        if latest is not None:
+            latest_out = {
+                "path": str(latest["path"]), "step": latest["step"],
+                "age_s": (round(now - float(latest["written_at"]), 3)
+                          if latest.get("written_at") else None),
+            }
+            if expect_fingerprint is None:
+                verdict = f"restorable (step {latest['step']})"
+            else:
+                diffs = fingerprint_mismatch(
+                    dict(latest.get("fingerprint") or {}),
+                    expect_fingerprint)
+                verdict = (f"MATCH — restore loads step {latest['step']}"
+                           if not diffs else
+                           "MISMATCH (CheckpointMismatch): " + ", ".join(
+                               f"{k}: checkpoint={a!r} plan={b!r}"
+                               for k, (a, b) in sorted(diffs.items())))
+        elif any(r["exists"] for r in scan):
+            verdict = "UNUSABLE: every generation fails validation"
+        return {"directory": self.directory, "generations": gens,
+                "latest": latest_out, "fingerprint_verdict": verdict}

@@ -1,0 +1,103 @@
+"""Solver-state capture/restore — the bridge between the solver
+protocol (``models/base.py``) and the checkpoint format.
+
+A pseudo-spectral solver's durable state is its SPECTRAL pytree (one
+array for :class:`~..solvers.navier_stokes.NavierStokes2D`, a 3-tuple of
+component spectra for ``NavierStokes3D``) plus the integration
+bookkeeping (step, dt, simulated time, RNG/forcing phase). ``capture``
+gathers the device arrays to host numpy (on a single-process CPU/TPU
+mesh ``np.asarray`` materializes the global padded array; each leaf's
+sharding spec is recorded in the section table for provenance) and
+stamps the plan fingerprint + wisdom provenance; ``restore`` re-places
+the validated host arrays into the CURRENT plan's declared spectral
+sharding (``plan.output_sharding``), so the resumed state is bit-for-bit
+the captured state, laid out exactly where the plan's pipelines expect
+it — the precondition of the bit-exact resume contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+from .checkpoint import SimState
+
+StateTree = Union[Any, Tuple[Any, ...]]
+
+_FIELD = "field{}"
+
+
+def plan_fingerprint(plan: Any) -> Dict[str, Any]:
+    """The identity a checkpoint records and restore validates —
+    ``resilience.guards.fingerprint`` with a fixed direction label (a
+    checkpoint belongs to the plan, not one direction)."""
+    from ..resilience import guards
+    return guards.fingerprint(plan, "state")
+
+
+def wisdom_provenance(plan: Any) -> Dict[str, Any]:
+    """Where the plan's autotuned choices came from: the wisdom store
+    path + its on-disk schema version at capture time (or an explicit
+    "no store"), so a resumed run's report can say whether it was built
+    from the same measurements."""
+    from ..utils import wisdom
+    store = wisdom.store_for_config(plan.config)
+    if store is None:
+        return {"path": None, "version": None}
+    return {"path": store.path, "version": store.raw_version()}
+
+
+def _leaves(state: StateTree) -> Tuple[Any, ...]:
+    return tuple(state) if isinstance(state, (tuple, list)) else (state,)
+
+
+def capture(solver: Any, state: StateTree, step: int, dt: float, *,
+            sim_time: float = 0.0, rng: Optional[Dict[str, Any]] = None,
+            meta: Optional[Dict[str, Any]] = None) -> SimState:
+    """Gather a solver's spectral state into a checkpointable
+    :class:`SimState` (host numpy; device arrays are materialized
+    here — call between steps, never inside a traced function)."""
+    leaves = _leaves(state)
+    plan = solver.plan
+    spec = getattr(plan, "output_spec", None)
+    arrays = {_FIELD.format(i): np.asarray(leaf)
+              for i, leaf in enumerate(leaves)}
+    meta_out = dict(meta or {})
+    meta_out.update({
+        "solver": type(solver).__name__,
+        "n_fields": len(leaves),
+        "tuple_state": isinstance(state, (tuple, list)),
+        "sharding": str(spec) if spec is not None else None,
+    })
+    return SimState(arrays=arrays, step=int(step), dt=float(dt),
+                    sim_time=float(sim_time), rng=rng,
+                    plan_fingerprint=plan_fingerprint(plan),
+                    wisdom=wisdom_provenance(plan), meta=meta_out)
+
+
+def restore(sim: SimState, solver: Any) -> StateTree:
+    """Re-place a validated :class:`SimState` onto the devices in the
+    CURRENT plan's spectral sharding; returns the solver-shaped state
+    pytree (tuple for multi-field solvers). Raises ``ValueError`` when
+    the checkpoint's field count disagrees with what it recorded —
+    format-level corruption is already excluded by the checksum pass,
+    so this only fires on a hand-edited header."""
+    import jax
+    n = int(sim.meta.get("n_fields", len(sim.arrays)))
+    names = [_FIELD.format(i) for i in range(n)]
+    missing = [nm for nm in names if nm not in sim.arrays]
+    if missing:
+        raise ValueError(f"checkpoint meta claims {n} field(s) but "
+                         f"sections {missing} are absent")
+    sharding = getattr(solver.plan, "output_sharding", None)
+    leaves = []
+    for nm in names:
+        host = sim.arrays[nm]
+        if sharding is not None:
+            leaves.append(jax.device_put(host, sharding))
+        else:
+            leaves.append(jax.device_put(host))
+    if sim.meta.get("tuple_state", n > 1):
+        return tuple(leaves)
+    return leaves[0]

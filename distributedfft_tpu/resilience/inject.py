@@ -34,6 +34,19 @@ same live server)::
                              # MS milliseconds (default 60000) per message
                              # — exercises the K-missed-heartbeats path
                              # (vs crash's broken-pipe path)
+    checkpoint:torn[:BYTES]  # every landed checkpoint write loses its
+                             # last BYTES bytes (default 64) — a torn
+                             # write the filesystem lost mid-rename; the
+                             # restore path must detect it (section CRC /
+                             # length) and fall back one generation
+    checkpoint:corrupt       # one byte of every landed checkpoint is
+                             # bit-flipped (offset keyed by @seed=) —
+                             # bitrot; caught by the CRC32C pass before
+                             # any byte reaches a device array
+    checkpoint:stale         # every landed checkpoint is re-stamped with
+                             # schema version 0 (checksums recomputed, so
+                             # ONLY schema validation can catch it) — an
+                             # ancient-format file a downgrade left behind
     coordinator:down[:K]     # coordinator connect fails (first K attempts;
                              # no K = every attempt)
     wisdom:stale-lock        # the wisdom advisory flock reads as held by a
@@ -76,6 +89,7 @@ _KINDS = {
     "wire": _WIRE_MODES,
     "server": ("slow",),
     "worker": ("crash", "hang"),
+    "checkpoint": ("torn", "corrupt", "stale"),
     "coordinator": ("down",),
     "wisdom": ("stale-lock",),
     "autotune": ("hang",),
@@ -313,6 +327,57 @@ def maybe_hang_worker(index: int, generation: int = 0) -> None:
     obs.metrics.inc("inject.worker_hangs")
     obs.event("inject.worker_hang", worker=int(index), ms=delay_ms)
     time.sleep(delay_ms / 1e3)
+
+
+def maybe_taint_checkpoint(path: str) -> None:
+    """Damage a checkpoint file that just LANDED on disk
+    (``checkpoint:torn|corrupt|stale``) — called by
+    ``persist/checkpoint.py`` after its atomic replace, simulating the
+    field faults the restore path's validation exists for:
+
+    * ``torn[:BYTES]`` truncates the final BYTES bytes (default 64) —
+      a write the filesystem lost mid-flush;
+    * ``corrupt`` XORs one byte at ``@seed= % filesize`` — bitrot;
+    * ``stale`` re-stamps the header with schema version 0 and
+      RECOMPUTES the header checksum, so only schema validation (not a
+      CRC) can refuse it.
+
+    Host-side file surgery only (zero traced ops); inactive = untouched.
+    """
+    spec = _spec_of("checkpoint")
+    if spec is None:
+        return
+    obs.metrics.inc("inject.checkpoint_faults")
+    obs.event("inject.checkpoint_fault", mode=spec.mode, path=path,
+              seed=spec.seed)
+    size = os.path.getsize(path)
+    if spec.mode == "torn":
+        cut = 64 if spec.param is None else max(1, int(spec.param))
+        with open(path, "r+b") as f:
+            f.truncate(max(0, size - cut))
+        return
+    if spec.mode == "corrupt":
+        idx = spec.seed % max(1, size)
+        with open(path, "r+b") as f:
+            f.seek(idx)
+            b = f.read(1)
+            f.seek(idx)
+            f.write(bytes([b[0] ^ 0x40]) if b else b"\x40")
+        return
+    # stale: rebuild the header with version 0 + a matching checksum
+    from ..persist import checkpoint as _ckpt
+    import json as _json
+    with open(path, "rb") as f:
+        blob = f.read()
+    nmag = len(_ckpt.MAGIC)
+    hlen = int.from_bytes(blob[nmag:nmag + 4], "little")
+    header = _json.loads(blob[nmag + 8:nmag + 8 + hlen].decode("utf-8"))
+    header["version"] = 0
+    hdr = _json.dumps(header, sort_keys=True).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(_ckpt.MAGIC + len(hdr).to_bytes(4, "little")
+                + _ckpt.crc32c(hdr).to_bytes(4, "little") + hdr
+                + blob[nmag + 8 + hlen:])
 
 
 def maybe_hang_cell(label: str) -> None:

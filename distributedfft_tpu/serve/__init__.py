@@ -31,14 +31,16 @@ from . import plancache
 from .fleet import Fleet, RemoteWorkerError, ScaleController
 from .plancache import (PlanCache, bucket_for, cache_key,
                         parse_request_key, request_key)
+from .resident import ResidentSolver
 from .router import FairQueue, RendezvousRing, TenantPolicy
 from .server import Overloaded, Server, ServerClosed, normalize_request
 
 __all__ = [
     "FairQueue", "Fleet", "Overloaded", "PlanCache", "RemoteWorkerError",
-    "RendezvousRing", "ScaleController", "Server", "ServerClosed",
-    "TenantPolicy", "bucket_for", "cache_key", "describe_request",
-    "normalize_request", "parse_request_key", "plancache", "request_key",
+    "RendezvousRing", "ResidentSolver", "ScaleController", "Server",
+    "ServerClosed", "TenantPolicy", "bucket_for", "cache_key",
+    "describe_request", "normalize_request", "parse_request_key",
+    "plancache", "request_key",
 ]
 
 

@@ -136,6 +136,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "(fleet mode)")
     ap.add_argument("--scale-cooldown-s", type=float, default=5.0,
                     help="minimum seconds between scale decisions")
+    # Resident solver tenant + durable state (ISSUE 14): a standing
+    # simulation stepping inside the serving process, checkpointed
+    # crash-consistently so drain/SIGTERM/worker death cannot destroy
+    # its progress. In fleet mode the resident lives on worker 0 and a
+    # replacement worker RESTORES it before rejoining the ring.
+    ap.add_argument("--resident", default=None, metavar="KIND:N[:BATCH]",
+                    help="host a resident solver (ns2d:64, ns2d:64:4, "
+                         "ns3d:32) stepping alongside request traffic")
+    ap.add_argument("--resident-dt", type=float, default=1e-3,
+                    help="resident integrator dt")
+    ap.add_argument("--resident-interval-ms", type=float, default=5.0,
+                    help="pause between resident steps (keeps the "
+                         "simulation from starving request traffic)")
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="two-generation checkpoint store for the "
+                         "resident's state (same as $DFFT_CKPT_DIR; "
+                         "unset = the resident runs without durability)")
+    ap.add_argument("--checkpoint-policy", default=None,
+                    metavar="steps:N[,secs:T][,drain:on|off]",
+                    help="when the resident checkpoints (same as "
+                         "$DFFT_CKPT_POLICY; default drain-only)")
     ap.add_argument("--http", type=int, default=0, metavar="PORT",
                     help="serve GET /healthz, GET /readyz and POST /fft "
                          "on this port (0 = off)")
@@ -201,6 +222,47 @@ def _parse_autoscale(s):
     if not 1 <= pair[0] <= pair[1]:
         raise SystemExit(f"--autoscale needs 1 <= MIN <= MAX, got {s!r}")
     return pair
+
+
+def _parse_resident(args):
+    """``--resident KIND:N[:BATCH]`` -> the picklable resident spec dict
+    ``serve.resident.ResidentSolver.build`` consumes (None when the flag
+    is absent)."""
+    if not args.resident:
+        if args.checkpoint_dir or args.checkpoint_policy:
+            raise SystemExit("--checkpoint-dir/--checkpoint-policy "
+                             "configure the resident solver's durable "
+                             "state; add --resident KIND:N")
+        return None
+    parts = args.resident.strip().lower().split(":")
+    if (len(parts) not in (2, 3) or parts[0] not in ("ns2d", "ns3d")
+            or (parts[0] == "ns3d" and len(parts) == 3)):
+        # ns3d has no ensemble axis — silently dropping a BATCH the
+        # operator asked for would fingerprint-bind checkpoints to an
+        # unintended configuration.
+        raise SystemExit(f"--resident wants ns2d:N[:BATCH] or ns3d:N, "
+                         f"got {args.resident!r}")
+    try:
+        spec = {"kind": parts[0], "n": int(parts[1]),
+                "batch": int(parts[2]) if len(parts) == 3 else 1}
+    except ValueError:
+        raise SystemExit(f"--resident sizes must be integers, got "
+                         f"{args.resident!r}") from None
+    if spec["n"] < 4 or spec["batch"] < 1:
+        # A degenerate grid fails later inside a worker subprocess as
+        # an opaque spawn error; refuse at startup instead.
+        raise SystemExit(f"--resident needs N >= 4 and BATCH >= 1, got "
+                         f"{args.resident!r}")
+    from .. import persist
+    try:
+        ckdir, policy = persist.resolve_env(args.checkpoint_dir,
+                                            args.checkpoint_policy)
+    except ValueError as e:  # fail loudly at startup
+        raise SystemExit(f"--checkpoint-policy: {e}") from None
+    spec.update(dt=args.resident_dt,
+                step_interval_ms=args.resident_interval_ms,
+                dir=ckdir, policy=policy)
+    return spec
 
 
 def _parse_shapes(s: str):
@@ -348,6 +410,7 @@ def main(argv=None) -> int:
         cache_capacity=args.cache_capacity, circuit_k=args.circuit_k,
         circuit_cooldown_s=args.circuit_cooldown_s)
     autoscale = _parse_autoscale(args.autoscale)
+    resident_spec = _parse_resident(args)
     if args.workers or autoscale:
         # Fleet mode (ISSUE 13): N shared-nothing subprocess workers,
         # each a full Server, behind the rendezvous plan-key router.
@@ -363,6 +426,7 @@ def main(argv=None) -> int:
             heartbeat_k=args.heartbeat_k,
             worker_inflight=args.worker_inflight,
             tenant_weights=_parse_tenant_weights(args.tenant_weights),
+            resident=resident_spec,
             **server_kwargs)
         if autoscale:
             server.attach_controller(ScaleController(
@@ -377,6 +441,20 @@ def main(argv=None) -> int:
                              "mode (--workers N or --autoscale MIN:MAX)")
         server = Server(pm.SlabPartition(args.partitions), cfg,
                         shard=args.shard, **server_kwargs)
+        if resident_spec is not None:
+            from .. import persist
+            from .resident import ResidentSolver
+            try:
+                server.attach_resident(ResidentSolver.build(
+                    dict(resident_spec, name="resident")))
+            except persist.CheckpointMismatch as e:
+                # The documented operator error (the dir belongs to a
+                # differently-configured run): a usage message, not a
+                # traceback — mirrors dfft-solve.
+                server.close(drain=False)
+                raise SystemExit(
+                    "dfft-serve: checkpoint store was written by a "
+                    f"different configuration — {e}") from None
 
     httpd = _make_http(server, args.http) if args.http else None
     stop = threading.Event()
@@ -445,6 +523,8 @@ def main(argv=None) -> int:
                     health["counters"].get("worker_deaths", 0)
                 summary["resubmitted"] = \
                     health["counters"].get("resubmitted", 0)
+            if resident_spec is not None:
+                summary["resident"] = health.get("resident")
             print(json.dumps(summary, sort_keys=True), flush=True)
         if args.obs:
             print("obs metrics: "

@@ -232,12 +232,24 @@ def _stats_lite(core: Any) -> Dict[str, Any]:
     into its ``/metrics`` surface for the scale controller."""
     h = core.health()
     c = h.get("counters", {})
-    return {"status": h.get("status"),
-            "queue_depth": h.get("queue_depth", 0),
-            "ema_ms": h.get("ema_ms"),
-            "served": c.get("served", 0), "shed": c.get("shed", 0),
-            "deadline_expired": c.get("deadline_expired", 0),
-            "batch_failures": c.get("batch_failures", 0)}
+    out = {"status": h.get("status"),
+           "queue_depth": h.get("queue_depth", 0),
+           "ema_ms": h.get("ema_ms"),
+           "served": c.get("served", 0), "shed": c.get("shed", 0),
+           "deadline_expired": c.get("deadline_expired", 0),
+           "batch_failures": c.get("batch_failures", 0)}
+    res = h.get("resident")
+    if res:
+        # The resident's progress rides the heartbeat so the ROUTER's
+        # health/summary can report the standing tenant without an
+        # extra round trip (kept small: the full registry stays in the
+        # worker's own health()).
+        out["resident"] = {"name": res.get("name"),
+                           "step": res.get("step"),
+                           "restored_from": res.get("restored_from"),
+                           "checkpoints": res.get("checkpoints"),
+                           "running": res.get("running")}
+    return out
 
 
 def _worker_main(conn: Any, spec: Dict[str, Any]) -> None:
@@ -266,6 +278,18 @@ def _worker_main(conn: Any, spec: Dict[str, Any]) -> None:
         cfg = spec.get("config") or pm.Config()
         core = Server(part, cfg, shard=spec.get("shard", "batch"),
                       name=spec["name"], **spec.get("server_kwargs", {}))
+    # Resident solver tenant (ISSUE 14): build — and, when its
+    # checkpoint store already holds a generation, RESTORE — the
+    # standing simulation BEFORE announcing ready, so a replacement
+    # worker rejoins the ring with the simulation already back at step
+    # k: persist.restore precedes fleet.worker_join in the event log,
+    # the chain the resume chaos drill validates.
+    res_spec = spec.get("resident")
+    if res_spec and spec.get("backend") != "stub":
+        from .resident import ResidentSolver
+        resident = ResidentSolver.build(
+            dict(res_spec, name=f"{spec['name']}-resident"))
+        core.attach_resident(resident)
 
     send_lock = threading.Lock()
 
@@ -455,6 +479,8 @@ class Fleet:
                  spawn_timeout_s: float = SPAWN_TIMEOUT_S,
                  name: str = "dfft-fleet",
                  worker_env: Optional[Dict[str, str]] = None,
+                 resident: Optional[Dict[str, Any]] = None,
+                 resident_index: int = 0,
                  **server_kwargs: Any):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -481,6 +507,16 @@ class Fleet:
             "server_kwargs": dict(server_kwargs),
             "env": dict(worker_env or {}),
         }
+        # Resident solver tenant (ISSUE 14): hosted by ONE worker slot
+        # (default index 0). The slot is stable across respawns — a
+        # replacement worker keeps its index — so the replacement gets
+        # the resident spec too and restores from the checkpoint store
+        # before rejoining the ring.
+        if resident is not None and worker_backend == "stub":
+            raise ValueError("a resident solver needs the real Server "
+                             "worker backend (worker_backend='server')")
+        self._resident_spec = dict(resident) if resident else None
+        self._resident_index = int(resident_index)
         self._ctx = multiprocessing.get_context("spawn")
         self._lock = threading.Lock()
         self._workers: Dict[str, _Worker] = {}
@@ -554,7 +590,9 @@ class Fleet:
                ) -> _Worker:
         name = f"worker-{index}"
         spec = dict(self._spec_base, name=name, index=index,
-                    generation=generation, prewarm=prewarm or [])
+                    generation=generation, prewarm=prewarm or [],
+                    resident=(self._resident_spec
+                              if index == self._resident_index else None))
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(target=_worker_main,
                                  args=(child_conn, spec),
@@ -1170,9 +1208,18 @@ class Fleet:
                     or any(s["state"] != "ready" for s in wsnap.values()))
         status = (state if state != "running"
                   else ("degraded" if degraded else "ok"))
+        # The standing resident's progress as folded from its host
+        # worker's latest heartbeat (None when no resident configured
+        # or its worker has not ponged yet).
+        resident = None
+        for s in wsnap.values():
+            if s["stats"].get("resident"):
+                resident = dict(s["stats"]["resident"])
+                break
         from ..obs import flightrec
         return {
             "status": status,
+            "resident": resident,
             "uptime_s": round(now - self._started_at, 3),
             "workers": wsnap,
             "ring": list(self.ring.members()),

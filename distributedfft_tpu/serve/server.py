@@ -231,6 +231,7 @@ class Server:
                         "batch_failures": 0, "coalesced": 0}
         self._inflight = 0
         self._shed_times: collections.deque = collections.deque()
+        self._resident: Optional[Any] = None  # attach_resident()
         obs.event("serve.start", server=name, shard=shard,
                   ranks=self.partition.num_ranks, max_queue=max_queue,
                   latency_budget_ms=latency_budget_ms,
@@ -651,6 +652,25 @@ class Server:
                           coalesced_n=n)
                 settle_future(r.future, result=np.array(res[i]))
 
+    # -- resident solver tenant (ISSUE 14) ---------------------------------
+
+    def attach_resident(self, resident: Any) -> None:
+        """Host a :class:`~.resident.ResidentSolver`: start its stepping
+        thread and own its lifecycle — ``close(drain=True)`` stops it
+        THROUGH its drain-checkpoint path (the policy's ``drain:on``
+        writes a final generation), so a SIGTERM'd or scaled-down server
+        leaves resumable state behind; ``health()`` gains a
+        ``resident`` block."""
+        with self._lock:
+            if self._resident is not None:
+                raise RuntimeError("a resident solver is already attached")
+            self._resident = resident
+        resident.start()
+
+    @property
+    def resident(self) -> Optional[Any]:
+        return self._resident
+
     # -- health / lifecycle ------------------------------------------------
 
     def health(self) -> Dict[str, Any]:
@@ -680,6 +700,12 @@ class Server:
             }
         snap["plan_cache"] = self.cache.snapshot()
         snap["obs_metrics"] = obs.snapshot()
+        # Resident simulation (ISSUE 14): step progress + checkpoint
+        # registry, so /healthz shows how far the standing tenant is and
+        # where (and how fresh) its durable state lives.
+        res = self._resident
+        if res is not None:
+            snap["resident"] = res.status()
         # Flight recorder (ISSUE 12): ring occupancy + the most recent
         # triggered dump's path, so an operator reading /healthz knows
         # where the post-mortem evidence landed.
@@ -701,6 +727,12 @@ class Server:
         lines were flushed as they were written (atomic replace /
         per-line append); the final ``serve.stop`` event carries the
         counter totals as the run's closing record."""
+        # The resident stops FIRST, through its drain-checkpoint path
+        # (drain=True + policy drain:on writes the final generation) —
+        # its state must be on disk before the process can be reaped.
+        res = self._resident
+        if res is not None:
+            res.stop(checkpoint=drain)
         with self._cv:
             if self._state == "stopped":
                 return

@@ -368,13 +368,15 @@ def test_srclint_scans_serve_and_solvers():
     exactly like one in the wisdom store."""
     files = srclint.scanned_files()
     for suffix in ("serve/server.py", "serve/plancache.py",
-                   "solvers/navier_stokes.py", "solvers/poisson.py"):
+                   "solvers/navier_stokes.py", "solvers/poisson.py",
+                   "persist/checkpoint.py", "persist/policy.py"):
         assert any(f.replace("\\", "/").endswith(suffix) for f in files), \
             f"{suffix} outside the srclint walk"
     unlocked = ("import os\n"
                 "def spill(path, data):\n"
                 "    os.replace('tmp', path)\n")
-    for path in ("x/serve/plancache.py", "x/solvers/checkpoint.py"):
+    for path in ("x/serve/plancache.py", "x/solvers/checkpoint.py",
+                 "x/persist/checkpoint.py"):
         assert [f.rule for f in srclint.lint_source(unlocked, path)] == \
             ["wisdom-flock"], path
     # Unconstrained elsewhere; locked form clean inside the scope.

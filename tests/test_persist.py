@@ -1,0 +1,530 @@
+"""Tier-1 gates of the persist layer (ISSUE 14): checkpoint format,
+two-generation rotation, corruption fallback, policy, solver
+capture/restore bit-exactness, and the serve resident's drain/restore
+path. Long chaos runs (SIGTERM subprocess, fleet worker-death restore,
+the slab-family in-process resume) are marked ``slow`` — the CI chaos
+``resume`` scenarios run them on every PR outside the tier-1 budget."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributedfft_tpu import obs, persist
+from distributedfft_tpu import params as pm
+from distributedfft_tpu.obs import flightrec
+from distributedfft_tpu.persist import (CheckpointCorrupt,
+                                        CheckpointMismatch,
+                                        CheckpointMissing,
+                                        CheckpointPolicy, CheckpointStore,
+                                        CheckpointUnusable, SimState,
+                                        crc32c, read_checkpoint,
+                                        write_checkpoint)
+from distributedfft_tpu.serve.resident import ResidentSolver, advance_steps
+
+
+def _state(step=1, arr=None, fp=None):
+    if arr is None:
+        arr = np.arange(24, dtype=np.complex128).reshape(4, 6)
+    return SimState(arrays={"field0": arr}, step=step, dt=1e-3,
+                    sim_time=step * 1e-3, rng={"seed": 7, "draws": step},
+                    plan_fingerprint=fp or {"plan": "T", "shape": [4, 6]},
+                    meta={"n_fields": 1, "tuple_state": False})
+
+
+# ---------------------------------------------------------------------------
+# format
+# ---------------------------------------------------------------------------
+
+def test_crc32c_known_answers():
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283  # the Castagnoli KAT
+    # incremental == one-shot
+    assert crc32c(b"6789", crc32c(b"12345")) == 0xE3069283
+    # numpy buffers work directly
+    a = np.arange(16, dtype=np.float32)
+    assert crc32c(a) == crc32c(a.tobytes())
+
+
+def test_checkpoint_roundtrip_preserves_everything(tmp_path):
+    p = str(tmp_path / "c.dfft")
+    arrays = {
+        "f32": np.random.default_rng(0).standard_normal((3, 5))
+        .astype(np.float32),
+        "c64": (np.random.default_rng(1).standard_normal((2, 4))
+                + 1j).astype(np.complex64),
+        "c128": np.random.default_rng(2).standard_normal((7,))
+        .astype(np.complex128),
+    }
+    st = SimState(arrays=arrays, step=42, dt=2e-3, sim_time=0.084,
+                  rng={"seed": 3, "draws": 42},
+                  plan_fingerprint={"plan": "SlabFFTPlan", "opt": 1},
+                  wisdom={"path": "/w.json", "version": 4},
+                  meta={"note": "x"})
+    n = write_checkpoint(p, st)
+    assert n == os.path.getsize(p) and st.written_at is not None
+    got = read_checkpoint(p)
+    for k, a in arrays.items():
+        assert got.arrays[k].dtype == a.dtype
+        assert got.arrays[k].tobytes() == a.tobytes()  # bit-exact
+    assert (got.step, got.dt, got.sim_time) == (42, 2e-3, 0.084)
+    assert got.rng == {"seed": 3, "draws": 42}
+    assert got.plan_fingerprint == {"plan": "SlabFFTPlan", "opt": 1}
+    assert got.wisdom == {"path": "/w.json", "version": 4}
+    assert got.meta == {"note": "x"}
+
+
+@pytest.mark.parametrize("damage", ["magic", "header", "payload",
+                                    "truncate", "short"])
+def test_every_damage_class_detected(tmp_path, damage):
+    p = str(tmp_path / "c.dfft")
+    write_checkpoint(p, _state())
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        if damage == "magic":
+            f.write(b"NOTACKPT")
+        elif damage == "header":
+            f.seek(20)
+            b = f.read(1)
+            f.seek(20)
+            f.write(bytes([b[0] ^ 1]))
+        elif damage == "payload":
+            f.seek(size - 3)
+            b = f.read(1)
+            f.seek(size - 3)
+            f.write(bytes([b[0] ^ 0x80]))
+        elif damage == "truncate":
+            f.truncate(size - 16)
+        else:  # short
+            f.truncate(4)
+    with pytest.raises(CheckpointCorrupt):
+        read_checkpoint(p)
+
+
+def test_unsupported_version_refused(tmp_path):
+    # A version-0 header with a VALID checksum (the checkpoint:stale
+    # shape): only schema validation can refuse it.
+    p = str(tmp_path / "c.dfft")
+    write_checkpoint(p, _state())
+    from distributedfft_tpu.persist import checkpoint as ck
+    with open(p, "rb") as f:
+        blob = f.read()
+    nm = len(ck.MAGIC)
+    hlen = int.from_bytes(blob[nm:nm + 4], "little")
+    hdr = json.loads(blob[nm + 8:nm + 8 + hlen])
+    hdr["version"] = 99
+    raw = json.dumps(hdr, sort_keys=True).encode()
+    with open(p, "wb") as f:
+        f.write(ck.MAGIC + len(raw).to_bytes(4, "little")
+                + crc32c(raw).to_bytes(4, "little") + raw
+                + blob[nm + 8 + hlen:])
+    with pytest.raises(CheckpointCorrupt, match="version"):
+        read_checkpoint(p)
+
+
+# ---------------------------------------------------------------------------
+# store: rotation / fallback / refusal
+# ---------------------------------------------------------------------------
+
+def test_rotation_two_slots_latest_wins(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    paths = [st.save(_state(step=i)) for i in (1, 2, 3)]
+    assert paths[0] != paths[1]
+    assert paths[0] == paths[2]  # alternation: gen 3 overwrote the older
+    assert st.load().step == 3
+    d = st.describe()
+    assert d["latest"]["step"] == 3
+    assert {g["step"] for g in d["generations"]} == {2, 3}
+    assert all(g["valid"] for g in d["generations"])
+
+
+def test_corrupt_newest_falls_back_exactly_one_generation(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("DFFT_FLIGHTREC_DIR", str(tmp_path / "fr"))
+    flightrec.clear()
+    st = CheckpointStore(str(tmp_path))
+    a = np.arange(12, dtype=np.complex64).reshape(3, 4)
+    st.save(_state(step=5, arr=a))
+    time.sleep(0.02)  # distinct mtimes: the store orders by newest write
+    p2 = st.save(_state(step=6, arr=a * 2))
+    with open(p2, "r+b") as f:
+        f.seek(30)
+        b = f.read(1)
+        f.seek(30)
+        f.write(bytes([b[0] ^ 1]))
+    before = obs.metrics.counter_total("persist.generation_fallbacks")
+    got = st.load()
+    assert got.step == 5  # fell back one generation
+    assert got.arrays["field0"].tobytes() == a.tobytes()  # never garbage
+    assert obs.metrics.counter_total("persist.generation_fallbacks") \
+        == before + 1
+    dump = flightrec.last_dump()
+    assert dump and dump["trigger"] == "checkpoint_restore_failure"
+    assert flightrec.validate_dump_file(dump["path"]) >= 0
+
+
+def test_both_generations_bad_refuses_structurally(tmp_path, monkeypatch):
+    monkeypatch.setenv("DFFT_FLIGHTREC_DIR", str(tmp_path / "fr"))
+    st = CheckpointStore(str(tmp_path))
+    paths = [st.save(_state(step=i)) for i in (1, 2)]
+    for p in paths:
+        with open(p, "r+b") as f:
+            f.truncate(8)
+    before = obs.metrics.counter_total("persist.restore_failures")
+    with pytest.raises(CheckpointUnusable) as ei:
+        st.load()
+    assert len(ei.value.reasons) == 2
+    assert obs.metrics.counter_total("persist.restore_failures") \
+        == before + 1
+
+
+def test_missing_store_is_a_fresh_start_not_a_failure(tmp_path):
+    with pytest.raises(CheckpointMissing):
+        CheckpointStore(str(tmp_path / "empty")).load()
+
+
+def test_fingerprint_mismatch_refused_without_fallback(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    st.save(_state(step=4, fp={"plan": "A", "comm": "All2All"}))
+    with pytest.raises(CheckpointMismatch) as ei:
+        st.load(expect_fingerprint={"plan": "A", "comm": "Ring"})
+    assert ei.value.diffs == {"comm": ("All2All", "Ring")}
+    # the matching fingerprint loads fine
+    got = st.load(expect_fingerprint={"plan": "A", "comm": "All2All"})
+    assert got.step == 4
+    # describe (the explain registry) renders the SAME verdict
+    d = st.describe(expect_fingerprint={"plan": "A", "comm": "Ring"})
+    assert d["fingerprint_verdict"].startswith("MISMATCH")
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+def test_policy_parse_roundtrip_and_defaults():
+    p = CheckpointPolicy.parse("steps:10,secs:30,drain:off")
+    assert (p.every_steps, p.every_s, p.on_drain) == (10, 30.0, False)
+    assert CheckpointPolicy.parse(str(p)) == p  # round-trips
+    assert CheckpointPolicy.parse(None) == CheckpointPolicy()
+    assert CheckpointPolicy.parse("").on_drain is True
+    for bad in ("steps", "steps:0", "secs:-1", "drain:maybe",
+                "steps:5,steps:6", "every:3", "steps:5,,"):
+        with pytest.raises(ValueError):
+            CheckpointPolicy.parse(bad)
+
+
+def test_policy_due_and_next():
+    p = CheckpointPolicy.parse("steps:5,secs:10")
+    assert p.due(4, 0, 100.0, 101.0) is None
+    assert p.due(5, 0, 100.0, 101.0) == "steps:5"
+    assert p.due(2, 0, 100.0, 111.0) == "secs:10"
+    assert "at step 5" in p.describe_next(2, 0, 100.0, 101.0)
+    drain_only = CheckpointPolicy()
+    assert drain_only.due(999, 0, 0.0, 1e9) is None
+    assert "on drain" in drain_only.describe_next(0, 0, 0.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# fault injection (checkpoint:torn / corrupt / stale)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault,expect", [
+    ("checkpoint:torn:200", "torn payload|short|truncated"),
+    ("checkpoint:corrupt@seed=100", "CRC32C"),
+    ("checkpoint:stale", "version 0"),
+])
+def test_injected_fault_detected_and_falls_back(tmp_path, monkeypatch,
+                                                fault, expect):
+    import re
+    st = CheckpointStore(str(tmp_path))
+    a = np.linspace(0, 1, 30).astype(np.complex128).reshape(5, 6)
+    st.save(_state(step=1, arr=a))  # clean older generation
+    time.sleep(0.02)
+    monkeypatch.setenv("DFFT_FAULT_SPEC", fault)
+    p2 = st.save(_state(step=2, arr=a * 3))  # faulted newest
+    monkeypatch.delenv("DFFT_FAULT_SPEC")
+    with pytest.raises(CheckpointCorrupt) as ei:
+        read_checkpoint(p2)
+    assert re.search(expect, ei.value.reason), ei.value.reason
+    before = obs.metrics.counter_total("persist.generation_fallbacks")
+    got = st.load()
+    assert got.step == 1
+    assert got.arrays["field0"].tobytes() == a.tobytes()  # zero garbage
+    assert obs.metrics.counter_total("persist.generation_fallbacks") \
+        == before + 1
+
+
+def test_checkpoint_fault_grammar():
+    from distributedfft_tpu.resilience.inject import (parse_fault_spec,
+                                                      parse_fault_specs)
+    s = parse_fault_spec("checkpoint:torn:128@seed=2")
+    assert (s.kind, s.mode, s.param, s.seed) == ("checkpoint", "torn",
+                                                 128.0, 2)
+    assert parse_fault_spec(str(s)) == s  # round-trips
+    assert parse_fault_spec("checkpoint:stale").param is None
+    # comma-composable with other kinds; one per kind enforced
+    specs = parse_fault_specs("wire:nan,checkpoint:corrupt@seed=9")
+    assert {sp.kind for sp in specs} == {"wire", "checkpoint"}
+    for bad in ("checkpoint:rot", "checkpoint",
+                "checkpoint:torn,checkpoint:stale"):
+        with pytest.raises(ValueError):
+            (parse_fault_specs if "," in bad else parse_fault_spec)(bad)
+
+
+def test_restore_failure_trigger_in_vocabulary():
+    assert "checkpoint_restore_failure" in flightrec.TRIGGERS
+
+
+# ---------------------------------------------------------------------------
+# solver capture/restore: bit-exact resume (the acceptance experiment)
+# ---------------------------------------------------------------------------
+
+def _bitexact_resume(solver, state0, dt, tmp_path, k=2, extra=2):
+    """Run k+extra steps straight vs k steps + checkpoint + restore +
+    extra steps with the SAME jitted step fn; states must be
+    bit-identical leaf by leaf."""
+    step = jax.jit(solver.step_fn(dt))
+    ref = advance_steps(step, state0, k + extra)
+    mid = advance_steps(step, state0, k)
+    store = CheckpointStore(str(tmp_path))
+    store.save(persist.capture(solver, mid, k, dt, rng={"seed": 0}))
+    sim = store.load(
+        expect_fingerprint=persist.plan_fingerprint(solver.plan))
+    assert sim.step == k and sim.wisdom.get("path") is None
+    back = persist.restore(sim, solver)
+    res = advance_steps(step, back, extra)
+    ref_l = ref if isinstance(ref, tuple) else (ref,)
+    res_l = res if isinstance(res, tuple) else (res,)
+    assert len(ref_l) == len(res_l)
+    for r, g in zip(ref_l, res_l):
+        ra, ga = np.asarray(r), np.asarray(g)
+        assert ra.dtype == ga.dtype and ra.shape == ga.shape
+        assert ra.tobytes() == ga.tobytes()  # BIT-exact
+
+
+def test_bitexact_resume_batched2d_shard_x(tmp_path, devices):
+    from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+    from distributedfft_tpu.solvers import NavierStokes2D, taylor_green_2d
+    plan = Batched2DFFTPlan(2, 24, 24, pm.SlabPartition(8),
+                            pm.Config(double_prec=True), shard="x")
+    ns = NavierStokes2D(plan, 1e-2)
+    w0 = ns.to_spectral(taylor_green_2d(24, batch=2))
+    _bitexact_resume(ns, w0, 1e-3, tmp_path)
+
+
+@pytest.mark.slow  # the second plan family rides the CI resume scenario;
+# tier-1 keeps one in-process family (suite budget, ISSUE 14 satellite)
+def test_bitexact_resume_slab(tmp_path, devices):
+    from distributedfft_tpu.models.slab import SlabFFTPlan
+    from distributedfft_tpu.solvers import NavierStokes3D, taylor_green_3d
+    plan = SlabFFTPlan(pm.GlobalSize(16, 16, 16), pm.SlabPartition(8),
+                       pm.Config(double_prec=True))
+    ns = NavierStokes3D(plan, 1e-2)
+    u0 = ns.to_spectral(taylor_green_3d(16))
+    _bitexact_resume(ns, u0, 1e-3, tmp_path)
+
+
+@pytest.mark.slow  # same plan build as the bit-exact test; the tier-1
+# budget keeps one 8-dev solver compile in this file (ISSUE 14 satellite)
+def test_restore_refuses_mismatched_plan(tmp_path, devices):
+    from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+    from distributedfft_tpu.solvers import NavierStokes2D, taylor_green_2d
+    plan = Batched2DFFTPlan(2, 24, 24, pm.SlabPartition(8),
+                            pm.Config(double_prec=True), shard="x")
+    ns = NavierStokes2D(plan, 1e-2)
+    w0 = ns.to_spectral(taylor_green_2d(24, batch=2))
+    store = CheckpointStore(str(tmp_path))
+    store.save(persist.capture(ns, w0, 1, 1e-3))
+    fp = dict(persist.plan_fingerprint(plan), wire="bf16")
+    with pytest.raises(CheckpointMismatch) as ei:
+        store.load(expect_fingerprint=fp)
+    assert "wire" in ei.value.diffs
+
+
+# ---------------------------------------------------------------------------
+# serve resident: drain checkpoint + restore-before-ready
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # live Server + stepping resident (~2 s); the CI
+# fleet-resume drill exercises the same drain/restore path per-PR
+def test_server_drain_checkpoints_and_resident_restores(tmp_path):
+    from distributedfft_tpu.serve.server import Server
+    d = str(tmp_path / "ck")
+    spec = {"kind": "ns2d", "n": 16, "batch": 1, "dt": 1e-3, "dir": d,
+            "policy": "steps:2", "step_interval_ms": 1, "name": "res"}
+    srv = Server(pm.SlabPartition(1), pm.Config())
+    srv.attach_resident(ResidentSolver.build(spec))
+    deadline = time.monotonic() + 120
+    while srv.resident.step < 4 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert srv.resident.step >= 4
+    h = srv.health()
+    assert h["resident"]["running"] and h["resident"]["checkpoints"] >= 1
+    srv.close(drain=True)  # drain writes the final generation
+    sim = CheckpointStore(d).load()
+    assert sim.meta["reason"] == "drain"
+    stopped_at = sim.step
+    assert obs.metrics.gauge_value("persist.last_checkpoint_age_s") >= 0
+    # a replacement resident restores BEFORE stepping — the simulation
+    # continues, never restarts
+    res2 = ResidentSolver.build(spec)
+    assert res2.restored_from == stopped_at and res2.step == stopped_at
+
+
+def test_resident_fresh_start_after_unusable_store(tmp_path):
+    # every generation corrupt: the resident must still come up (fresh),
+    # with restore_failures evidence — never load garbage, never die.
+    d = tmp_path / "ck"
+    st = CheckpointStore(str(d))
+    p = st.save(_state(step=9))
+    with open(p, "r+b") as f:
+        f.truncate(6)
+    spec = {"kind": "ns2d", "n": 16, "batch": 1, "dt": 1e-3,
+            "dir": str(d), "name": "res"}
+    before = obs.metrics.counter_total("persist.restore_failures")
+    res = ResidentSolver.build(spec)
+    assert res.restored_from is None and res.step == 0
+    assert obs.metrics.counter_total("persist.restore_failures") \
+        == before + 1
+
+
+def test_resident_mismatch_propagates(tmp_path):
+    # the operator pointed a DIFFERENT simulation at this store:
+    # refusing beats silently discarding hours of state.
+    d = tmp_path / "ck"
+    spec = {"kind": "ns2d", "n": 16, "batch": 1, "dt": 1e-3,
+            "dir": str(d), "name": "res"}
+    res = ResidentSolver.build(spec)
+    res.checkpoint("manual")
+    spec32 = dict(spec, n=32)
+    with pytest.raises(CheckpointMismatch):
+        ResidentSolver.build(spec32)
+
+
+# ---------------------------------------------------------------------------
+# dfft-explain checkpoint: section (same registry as restore)
+# ---------------------------------------------------------------------------
+
+def test_explain_checkpoint_section(tmp_path, capsys, devices):
+    from distributedfft_tpu.obs import explain
+    argv = ["--kind", "batched", "-nx", "16", "-ny", "16", "-nz", "1",
+            "--shard", "batch", "-p", "8", "--emulate-devices", "8",
+            "--no-compile"]
+    assert explain.main(argv) == 0
+    out = capsys.readouterr().out
+    assert "checkpoint:" in out
+    assert "none configured" in out
+    # now with a store holding a foreign-plan checkpoint: MISMATCH
+    st = CheckpointStore(str(tmp_path))
+    st.save(_state(step=3, fp={"plan": "SomethingElse"}))
+    assert explain.main(argv + ["--checkpoint-dir", str(tmp_path),
+                                "--checkpoint-policy", "steps:4"]) == 0
+    out = capsys.readouterr().out
+    assert "MISMATCH (CheckpointMismatch)" in out
+    assert "step 3" in out
+    assert "policy: steps:4,drain:on" in out
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos (slow; the CI resume scenario runs these per-PR)
+# ---------------------------------------------------------------------------
+
+def _run_driver(args, timeout=240, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DFFT_FAULT_SPEC", None)
+    return subprocess.run(
+        [sys.executable, "-m", "distributedfft_tpu.solvers.driver"]
+        + args, env=env, capture_output=True, text=True,
+        timeout=timeout, **kw)
+
+
+@pytest.mark.slow
+def test_driver_sigterm_resume_bitexact(tmp_path):
+    d = str(tmp_path)
+    ck = os.path.join(d, "ck")
+    base = ["--kind", "ns2d", "--n", "24", "--steps", "10",
+            "--emulate-devices", "8", "-p", "8", "--shard", "x"]
+    r = _run_driver(base + ["--out", f"{d}/a.npy"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "distributedfft_tpu.solvers.driver"]
+        + base + ["--checkpoint-dir", ck, "--checkpoint-policy",
+                  "steps:2", "--step-interval-ms", "500"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    t0 = time.monotonic()
+    while (time.monotonic() - t0 < 150
+           and not glob.glob(os.path.join(ck, "ckpt-*.dfft"))):
+        time.sleep(0.1)
+    time.sleep(0.7)
+    p.send_signal(signal.SIGTERM)
+    out, err = p.communicate(timeout=120)
+    assert p.returncode == 0, err[-2000:]
+    s1 = json.loads(out.strip().splitlines()[-1])
+    assert s1["interrupted"] and 0 < s1["step"] < 10, s1
+    r2 = _run_driver(base + ["--checkpoint-dir", ck, "--resume",
+                             "--out", f"{d}/b.npy"])
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    s2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert s2["restored_from"] == s1["step"] and s2["step"] == 10
+    a, b = np.load(f"{d}/a.npy"), np.load(f"{d}/b.npy")
+    assert a.tobytes() == b.tobytes()  # SIGTERM+resume == uninterrupted
+
+
+@pytest.mark.slow
+def test_fleet_worker_crash_resident_restores(tmp_path, monkeypatch):
+    """worker:crash kills the worker hosting the resident; the
+    replacement must RESTORE the simulation (restored_from > 0) before
+    rejoining — the simulation continues, never restarts."""
+    from distributedfft_tpu.serve.fleet import Fleet
+    monkeypatch.setenv("DFFT_FAULT_SPEC", "worker:crash:2@seed=0")
+    d = str(tmp_path / "ck")
+    resident = {"kind": "ns2d", "n": 16, "batch": 1, "dt": 1e-3,
+                "dir": d, "policy": "steps:2", "step_interval_ms": 20}
+    fleet = Fleet(1, partition=pm.SlabPartition(1),
+                  worker_backend="server", resident=resident,
+                  heartbeat_interval_s=0.25, heartbeat_k=20,
+                  spawn_timeout_s=240.0)
+    try:
+        # wait for a first checkpoint from generation 0
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            h = fleet.health()
+            r = h.get("resident")
+            if r and (r.get("checkpoints") or 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert r and r["checkpoints"] >= 1, h
+        # the 2nd request crashes worker 0 (generation 0 only)
+        x = np.random.default_rng(0).standard_normal((16, 16)) \
+            .astype(np.float32)
+        for _ in range(2):
+            try:
+                fleet.request(x, timeout_s=60)
+            except Exception:
+                pass  # the crashed request is resubmitted by the fleet
+        deadline = time.monotonic() + 240
+        restored = None
+        while time.monotonic() < deadline:
+            h = fleet.health()
+            r = h.get("resident")
+            if (h["counters"]["worker_restarts"] >= 1 and r
+                    and r.get("restored_from")):
+                restored = r
+                break
+            time.sleep(0.3)
+        assert restored is not None, fleet.health()
+        assert restored["restored_from"] > 0
+        assert restored["step"] >= restored["restored_from"]
+    finally:
+        monkeypatch.delenv("DFFT_FAULT_SPEC", raising=False)
+        fleet.close(drain=False)

@@ -878,11 +878,14 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
 
         # Stage-attributed device profile (ISSUE 12): the slab forward at
         # the mesh size under jax.profiler, device time joined onto the
-        # declared plan-graph nodes — plus the RING vs RING_OVERLAP pair
-        # at a small size, so ROADMAP item 3's overlap decision is
-        # ATTRIBUTED (which stage's time moved), not just timed. Guarded
-        # and headroom-gated: attribution extras never cost the core
-        # metrics or the deadline.
+        # declared plan-graph nodes — plus the overlap-schedule sweep at
+        # a small size (ISSUE 16: serial ring, the shipped depth-2
+        # overlap, the depth-4/8 revolving rings, the sub-block split,
+        # and the pipelined all-to-all), so ROADMAP item 3's overlap
+        # decision is ATTRIBUTED (which stage's time moved), not just
+        # timed, and each sweep row carries its own roofline_fraction.
+        # Guarded and headroom-gated: attribution extras never cost the
+        # core metrics or the deadline.
         if time.monotonic() - t_child0 > 0.7 * MESH_TIMEOUT_S:
             out["stage_profile_error"] = \
                 "skipped: mesh child deadline headroom"
@@ -893,16 +896,45 @@ def _child_mesh(deadline_s: int = MESH_TIMEOUT_S) -> int:
                     prof_mod.stage_profile(plan, "forward", 3, iters=2))}
                 ng = 64
                 gg = dfft.GlobalSize(ng, ng, ng)
-                for label, snd in (("ring", dfft.SendMethod.RING),
-                                   ("ring_overlap",
-                                    dfft.SendMethod.RING_OVERLAP)):
+                ovl = dfft.SendMethod.RING_OVERLAP
+                sweep = (
+                    ("ring", dict(send_method=dfft.SendMethod.RING)),
+                    ("ring_overlap", dict(send_method=ovl)),
+                    ("ring_overlap_d4", dict(send_method=ovl,
+                                             overlap_depth=4)),
+                    ("ring_overlap_d8", dict(send_method=ovl,
+                                             overlap_depth=8)),
+                    ("ring_overlap_s2", dict(send_method=ovl,
+                                             overlap_subblocks=2)),
+                    ("a2a_pipe", dict(comm_method=dfft.CommMethod.ALL2ALL,
+                                      opt=1, overlap_subblocks=2)),
+                )
+                for label, kw in sweep:
                     op = dfft.SlabFFTPlan(gg, dfft.SlabPartition(p),
-                                          dfft.Config(send_method=snd),
+                                          dfft.Config(**kw),
                                           sequence="Z_Then_YX")
                     sp[label] = _stage_profile_brief(
                         prof_mod.stage_profile(op, "forward", 3, iters=2))
                     sp[label]["n"] = ng
                 out["stage_profile"] = sp
+                # Per-row roofline fraction for the overlap sweep (the
+                # acceptance gate: every sweep row is tracked, and the
+                # CI roofline job fails a >10% residual regression on
+                # any row present in the committed BENCH_DETAILS.json).
+                try:
+                    from distributedfft_tpu.evalkit import roofline as rl
+                    oroof = {}
+                    for label, _ in sweep:
+                        ms = sp.get(label, {}).get("total_ms")
+                        if ms:
+                            row = rl.roofline_row(ms, ng, "xla", p,
+                                                  mode="forward")
+                            if row:
+                                oroof[f"overlap:{label}"] = row
+                    if oroof:
+                        out["overlap_roofline"] = oroof
+                except Exception:  # noqa: BLE001 — attribution extra
+                    pass
             except TimeoutError:
                 raise
             except Exception as e:  # noqa: BLE001 — attribution extra
@@ -1834,6 +1866,10 @@ def main() -> int:
     # against the committed BENCH_DETAILS.json.
     roof_rows = {}
     roof_rows.update((mesh or {}).get("roofline") or {})
+    # The overlap-depth sweep rows (ISSUE 16): one tracked fraction per
+    # schedule variant (ring / depth-2/4/8 overlap / sub-block split /
+    # pipelined a2a), keyed "overlap:<variant>".
+    roof_rows.update((mesh or {}).get("overlap_roofline") or {})
     roof_rows.update((tpu or {}).get("roofline") or {})
     if roof_rows:
         result["roofline"] = {

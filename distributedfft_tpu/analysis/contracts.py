@@ -16,8 +16,12 @@ wire x guards) from two declarative sources:
   ============  =========================================================
   ``a2a``       exactly 1 ``all-to-all`` (sync or async-start form)
   ``streams``   exactly K ``all-to-all``\\ s (the chunked piece chains)
-  ``ring``      >= P-1 ``collective-permute``\\ s, 0 ``all-to-all``\\ s —
-                the un-fusable split-exchange signature (OVERLAP.md)
+  ``a2a_pipe``  exactly K ``all-to-all``\\ s (the software-pipelined
+                monolithic exchange; same K-instance pin as streams —
+                a GSPMD re-fuse back into one collective fails it)
+  ``ring``      >= (P-1) x S ``collective-permute``\\ s (S = sub-block
+                split), 0 ``all-to-all``\\ s — the un-fusable
+                split-exchange signature (OVERLAP.md)
   ``p2p``       GSPMD owns the schedule: >= 1 collective, exact counts
                 unpinnable across backends (every exact rule degrades to
                 a lower bound when a GSPMD exchange is present)
@@ -48,12 +52,17 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from . import hloscan
 
 # Rendering keys of a single exchange (``ExchangeDecl.rendering``).
-# "ring_overlap" is the double-buffered ring schedule (SendMethod.
-# RING_OVERLAP, with or without the fused wire kernels): same census
-# algebra and (P-1)/P payload discount as "ring" — the permutes must stay
-# distinct and un-fusable whichever schedule issued them, which is
-# exactly the pin that stops GSPMD from serializing the overlap back.
-RENDERINGS = ("a2a", "streams", "ring", "ring_overlap", "p2p")
+# "ring_overlap" is the revolving-buffer ring schedule (SendMethod.
+# RING_OVERLAP at any overlap depth, with or without the fused wire
+# kernels): same census algebra and (P-1)/P payload discount as "ring" —
+# the permutes must stay distinct and un-fusable whichever schedule
+# issued them, which is exactly the pin that stops GSPMD from
+# serializing the overlap back. "a2a_pipe" is the software-pipelined
+# monolithic exchange (ALL2ALL + SYNC/MPI_TYPE with overlap_subblocks >
+# 1, ``transpose.pipelined_all_to_all``): K chunked all-to-alls like
+# "streams", pinned to exactly K so a GSPMD re-fuse back into one
+# collective fails the census.
+RENDERINGS = ("a2a", "streams", "a2a_pipe", "ring", "ring_overlap", "p2p")
 
 # The renderings that stage a ppermute ring (shared by the census and
 # payload resolution below).
@@ -65,20 +74,26 @@ class ExchangeDecl:
     """One global exchange a plan direction stages: the declarative unit
     the family modules register (``label`` names it in diagnostics;
     ``payload_shape`` is the GLOBAL padded payload; ``axis_size`` the
-    participating mesh-axis extent; ``chunks`` the resolved STREAMS
-    piece count, 1 otherwise)."""
+    participating mesh-axis extent; ``chunks`` the resolved STREAMS /
+    a2a_pipe piece count, 1 otherwise; ``subblocks`` the resolved ring
+    sub-block split — each peer step becomes ``subblocks`` distinct
+    permutes, so the census scales with it)."""
 
     label: str
     payload_shape: Tuple[int, ...]
     axis_size: int
     rendering: str
     chunks: int = 1
+    subblocks: int = 1
 
     def __post_init__(self) -> None:
         if self.rendering not in RENDERINGS:
             raise ValueError(
                 f"rendering must be one of {RENDERINGS}, "
                 f"got {self.rendering!r}")
+        if self.subblocks < 1:
+            raise ValueError(
+                f"subblocks must be >= 1, got {self.subblocks}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,6 +209,10 @@ def rendering_name(config: Any, second: bool = False) -> str:
         return "p2p" if comm is pm.CommMethod.PEER2PEER else "streams"
     if comm is pm.CommMethod.PEER2PEER:
         return "p2p"
+    if config.resolved_overlap_subblocks() > 1:
+        # ALL2ALL + SYNC/MPI_TYPE with a sub-block split: the
+        # software-pipelined monolithic exchange.
+        return "a2a_pipe"
     return "a2a"
 
 
@@ -235,10 +254,12 @@ def contract_from_decls(family: str, direction: str, wire: str,
     for d in decls:
         if d.rendering == "a2a":
             n_a2a += 1
-        elif d.rendering == "streams":
+        elif d.rendering in ("streams", "a2a_pipe"):
             n_a2a += max(1, d.chunks)
         elif d.rendering in _RING_RENDERINGS:
-            ring_steps += max(0, d.axis_size - 1)
+            # Each peer step travels as ``subblocks`` distinct permutes
+            # (the block-granularity micro-steps).
+            ring_steps += max(0, d.axis_size - 1) * max(1, d.subblocks)
         else:
             n_gspmd += 1
         if d.rendering != "p2p":
@@ -265,7 +286,7 @@ def contract_from_decls(family: str, direction: str, wire: str,
     elif n_gspmd == 0:
         rules.append(Rule("census", "all_to_all", "==", n_a2a,
                           why="monolithic exchanges: one collective each; "
-                              "STREAMS: one per chunk"))
+                              "STREAMS/a2a_pipe: one per chunk"))
         if ring_steps:
             rules.append(Rule("census", "collective_permute", ">=",
                               ring_steps,

@@ -18,9 +18,10 @@ kind               meaning
                    transforms, in application order
 ``exchange``       one global exchange; carries the rendering key,
                    participating mesh-axis size, GLOBAL padded payload
-                   shape, resolved STREAMS chunk count and the ring
-                   schedule depth (0 = not a ring, 1 = serial ring,
-                   >= 2 = revolving-buffer overlap)
+                   shape, resolved STREAMS/a2a_pipe chunk count, the
+                   ring sub-block split and the schedule depth (0 = no
+                   pipelined schedule, 1 = serial ring, >= 2 =
+                   revolving-buffer overlap / pipelined-a2a window)
 ``encode``         the wire encode (complex -> planar bf16 pair)
 ``decode``         the wire decode (planar pair -> complex)
 ``fused_kernel``   a fused Pallas wire kernel; ``fuses`` names what it
@@ -83,6 +84,7 @@ class StageNode:
     rendering: str = ""
     axis_size: int = 0
     chunks: int = 1
+    subblocks: int = 1
     payload_shape: Tuple[int, ...] = ()
     schedule_depth: int = 0
     fuses: Tuple[str, ...] = ()
@@ -207,8 +209,9 @@ class GraphBuilder:
 
     def exchange(self, label: str, payload_shape: Iterable[int],
                  axis_size: int, rendering: str, *, chunks: int = 1,
-                 schedule_depth: int = 0, wire_spec: Any = "",
-                 decoded_spec: Any = "", fused_encode: bool = False,
+                 subblocks: int = 1, schedule_depth: int = 0,
+                 wire_spec: Any = "", decoded_spec: Any = "",
+                 fused_encode: bool = False,
                  decode_fuses: Optional[Tuple[str, ...]] = None) -> str:
         """Append one declared exchange as its full stage group —
         ``(encode ->) exchange (-> decode)`` under a compressed wire,
@@ -242,7 +245,7 @@ class GraphBuilder:
             self.payload(shape, self._cdt, wire_spec, pred)
         xid = self.node("exchange", label=label, rendering=rendering,
                         axis_size=axis_size, chunks=chunks,
-                        payload_shape=shape,
+                        subblocks=subblocks, payload_shape=shape,
                         schedule_depth=schedule_depth)
         if compressed:
             if decode_fuses:
@@ -259,15 +262,22 @@ class GraphBuilder:
                          tuple(self._nodes), tuple(self._edges))
 
 
-def shipped_schedule_depth(rendering: str) -> int:
-    """The ring-schedule depth a rendering ships with today: 2 for the
-    revolving double-buffered RING_OVERLAP pipeline, 1 for the serial
-    RING, 0 for every non-ring rendering. The single source the three
-    family ``_declare_graph`` hooks share — when ROADMAP item 3's
-    autotuned depth lands, it changes here, not in three copies."""
-    if rendering not in contracts._RING_RENDERINGS:
+def shipped_schedule_depth(rendering: str, config: Any = None) -> int:
+    """The pipelined-schedule depth a rendering ships with under
+    ``config``: the resolved ``Config.overlap_depth`` for the
+    revolving-buffer RING_OVERLAP pipeline and the pipelined a2a's
+    issue-ahead window ("auto" -> 2, the shipped double-buffered
+    schedule), 1 for the serial RING, 0 for every other rendering.
+    ``config=None`` keeps the pre-autotune defaults. The single source
+    the three family ``_declare_graph`` hooks share — ROADMAP item 3's
+    autotuned depth landed here, not in three copies."""
+    if rendering == "ring":
+        return 1
+    if rendering not in ("ring_overlap", "a2a_pipe"):
         return 0
-    return 2 if rendering == "ring_overlap" else 1
+    if config is None:
+        return 2
+    return int(config.resolved_overlap_depth())
 
 
 def payload_dtypes(config: Any, transform: str) -> Tuple[str, str]:
@@ -520,15 +530,33 @@ def _check_guard_arity(graph: PlanGraph) -> List[GraphViolation]:
 
 
 def _check_schedules(graph: PlanGraph) -> List[GraphViolation]:
-    """Every ring exchange's revolving-buffer schedule must prove
-    hazard-free at its declared depth (``analysis/schedverify.py``)."""
+    """Every pipelined exchange schedule must prove hazard-free at its
+    declared depth/sub-block split (``analysis/schedverify.py``): the
+    ring renderings' revolving-buffer micro-step schedule, and the
+    pipelined all_to_all's issue-ahead window (verified as the
+    equivalent K-step revolving discipline — K chunk collectives, the
+    same issue/wait/compute semantics)."""
     out: List[GraphViolation] = []
     for x in graph.exchanges():
+        if x.rendering == "a2a_pipe":
+            depth = x.schedule_depth
+            if depth < 1:
+                out.append(_viol(
+                    graph, "schedule",
+                    f"pipelined exchange {x.id!r} declares no schedule "
+                    f"depth"))
+                continue
+            k = max(1, x.chunks)
+            timeline = schedverify.revolving_schedule(k + 1, depth)
+            for h in schedverify.check_schedule(timeline, k + 1, depth):
+                out.append(_viol(graph, "schedule",
+                                 f"exchange {x.id!r}: {h}"))
+            continue
         if x.rendering not in contracts._RING_RENDERINGS:
             if x.schedule_depth:
                 out.append(_viol(
                     graph, "schedule",
-                    f"non-ring exchange {x.id!r} declares schedule "
+                    f"non-pipelined exchange {x.id!r} declares schedule "
                     f"depth {x.schedule_depth}"))
             continue
         depth = x.schedule_depth
@@ -542,8 +570,10 @@ def _check_schedules(graph: PlanGraph) -> List[GraphViolation]:
                 graph, "schedule",
                 f"ring_overlap exchange {x.id!r} declares depth "
                 f"{depth} — the revolving pipeline needs >= 2 buffers"))
-        timeline = schedverify.revolving_schedule(x.axis_size, depth)
-        for h in schedverify.check_schedule(timeline, x.axis_size, depth):
+        timeline = schedverify.revolving_schedule(x.axis_size, depth,
+                                                  x.subblocks)
+        for h in schedverify.check_schedule(timeline, x.axis_size, depth,
+                                            x.subblocks):
             out.append(_viol(graph, "schedule",
                              f"exchange {x.id!r}: {h}"))
     return out
@@ -629,7 +659,8 @@ def graph_decls(graph: PlanGraph) -> Tuple[contracts.ExchangeDecl, ...]:
     currency of the contract registry."""
     return tuple(contracts.ExchangeDecl(
         label=x.label or x.id, payload_shape=x.payload_shape,
-        axis_size=x.axis_size, rendering=x.rendering, chunks=x.chunks)
+        axis_size=x.axis_size, rendering=x.rendering, chunks=x.chunks,
+        subblocks=x.subblocks)
         for x in graph.exchanges())
 
 
@@ -641,7 +672,7 @@ def check_graph_contract(graph: PlanGraph,
     truth."""
     def key(d: contracts.ExchangeDecl) -> Tuple[Any, ...]:
         return (d.rendering, tuple(d.payload_shape), d.axis_size,
-                max(1, d.chunks))
+                max(1, d.chunks), max(1, d.subblocks))
 
     out: List[GraphViolation] = []
     got = sorted(key(d) for d in graph_decls(graph))
@@ -701,8 +732,9 @@ def check_graph_trace(plan: Any, graph: PlanGraph,
         jaxpr = jaxprlint.plan_jaxpr(plan, direction, dims)
     traced = _jaxpr_exchange_census(jaxpr)
     want_a2a = sum(max(1, d.chunks) for d in decls
-                   if d.rendering in ("a2a", "streams"))
-    want_pp = sum(max(0, d.axis_size - 1) for d in decls
+                   if d.rendering in ("a2a", "streams", "a2a_pipe"))
+    want_pp = sum(max(0, d.axis_size - 1) * max(1, d.subblocks)
+                  for d in decls
                   if d.rendering in contracts._RING_RENDERINGS)
     if traced["all_to_all"] < want_a2a:
         out.append(_viol(
@@ -777,7 +809,8 @@ def _node_brief(n: StageNode) -> str:
     if n.kind == "exchange":
         extra = f" depth={n.schedule_depth}" if n.schedule_depth else ""
         k = f" k={n.chunks}" if n.chunks > 1 else ""
-        return f"exchange[{n.rendering} P={n.axis_size}{k}{extra}]"
+        s = f" sub={n.subblocks}" if n.subblocks > 1 else ""
+        return f"exchange[{n.rendering} P={n.axis_size}{k}{s}{extra}]"
     if n.kind == "fused_kernel":
         return f"fused[{'+'.join(n.fuses)}]"
     return n.kind
@@ -796,9 +829,14 @@ def format_graph(graph: PlanGraph) -> List[str]:
     for x in graph.exchanges():
         ins = graph.in_edges(x.id)
         wb = ins[0].wire_bytes if ins else 0
+        sched = ""
+        if x.schedule_depth:
+            sched = f" (schedule depth {x.schedule_depth}"
+            if x.subblocks > 1:
+                sched += f", {x.subblocks} sub-blocks"
+            sched += ")"
         lines.append(
             f"  {x.label or x.id}: payload {x.payload_shape} "
             f"{graph.complex_dtype} -> {_fmt_bytes(wb)} on the wire"
-            + (f" (schedule depth {x.schedule_depth})"
-               if x.schedule_depth else ""))
+            + sched)
     return lines

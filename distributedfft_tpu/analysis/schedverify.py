@@ -92,18 +92,29 @@ class Hazard:
         return f"[schedverify/{self.kind}] step {self.step}: {self.message}"
 
 
-def revolving_schedule(p: int, depth: int = 2) -> Tuple[SchedOp, ...]:
+def revolving_schedule(p: int, depth: int = 2,
+                       subblocks: int = 1) -> Tuple[SchedOp, ...]:
     """The depth-D revolving-buffer pipeline of a ``p``-rank ring:
     ``p-1`` steps, up to ``depth`` blocks outstanding, block ``t`` in
     buffer ``(t-1) % depth``. ``depth=2`` reproduces the shipped
     RING_OVERLAP issue order (step ``t+1``'s permute before block
     ``t``'s compute); ``depth=1`` is the plain serial RING; ``p <= 1``
-    (single-peer degenerate) schedules nothing."""
+    (single-peer degenerate) schedules nothing.
+
+    ``subblocks`` > 1 models the block-granularity axis: each peer step
+    becomes ``subblocks`` MICRO-steps (sub-block ``(m-1) % S`` of peer
+    step ``(m-1) // S + 1`` — the exact linearization
+    ``_ring_transpose_impl`` traces), each riding its own permute into
+    its own revolving buffer, so the checker proves the sub-block
+    schedule under the same buffer discipline. The effective depth caps
+    at ``(p-1) * subblocks``."""
     if p < 1:
         raise ValueError(f"ring size must be >= 1, got {p}")
     if depth < 1:
         raise ValueError(f"buffer depth must be >= 1, got {depth}")
-    steps = p - 1
+    if subblocks < 1:
+        raise ValueError(f"subblocks must be >= 1, got {subblocks}")
+    steps = (p - 1) * subblocks
     if steps == 0:
         return ()
     d = min(depth, steps)
@@ -118,11 +129,14 @@ def revolving_schedule(p: int, depth: int = 2) -> Tuple[SchedOp, ...]:
     return tuple(ops)
 
 
-def check_schedule(ops: Any, p: int, depth: int) -> List[Hazard]:
+def check_schedule(ops: Any, p: int, depth: int,
+                   subblocks: int = 1) -> List[Hazard]:
     """Simulate one device's timeline and report every hazard (empty =
     the schedule is provably safe under the revolving-buffer semantics).
-    ``p`` is the ring size (steps 1..p-1 must each be issued, waited and
-    computed exactly once), ``depth`` the declared buffer count."""
+    ``p`` is the ring size (micro-steps 1..(p-1)*subblocks must each be
+    issued, waited and computed exactly once), ``depth`` the declared
+    buffer count, ``subblocks`` the per-peer block split the schedule
+    was generated for."""
     hazards: List[Hazard] = []
     issued: Dict[int, int] = {}    # step -> buffer
     arrived: set = set()
@@ -169,7 +183,7 @@ def check_schedule(ops: Any, p: int, depth: int) -> List[Hazard]:
             buf = issued.get(t)
             if buf is not None and owner.get(buf) == t:
                 del owner[buf]
-    for t in range(1, p):
+    for t in range(1, (p - 1) * max(1, subblocks) + 1):
         missing = [name for name, seen in
                    (("issue", t in issued), ("wait", t in arrived),
                     ("compute", t in computed)) if not seen]
@@ -181,14 +195,17 @@ def check_schedule(ops: Any, p: int, depth: int) -> List[Hazard]:
     return hazards
 
 
-def mutated_schedule(kind: str, p: int = 8,
-                     depth: int = 2) -> Tuple[SchedOp, ...]:
+def mutated_schedule(kind: str, p: int = 8, depth: int = 2,
+                     subblocks: int = 1) -> Tuple[SchedOp, ...]:
     """A synthetic schedule carrying exactly one hazard of ``kind`` —
     the self-test input proving the checker catches that class (the
-    schedule analog of ``dfft-verify --mutate``)."""
-    ops = list(revolving_schedule(p, depth))
+    schedule analog of ``dfft-verify --mutate``). ``subblocks`` > 1
+    mutates the sub-block micro-step schedule, proving the checker's
+    coverage extends to the block-granularity axis."""
+    ops = list(revolving_schedule(p, depth, subblocks))
     if p < 3:
         raise ValueError("mutations need a ring of >= 3 ranks")
+    last = (p - 1) * max(1, subblocks)
     if kind == "read-before-arrive":
         # Swap one wait past its compute: the FFT reads the buffer while
         # the DMA is still in flight.
@@ -205,7 +222,7 @@ def mutated_schedule(kind: str, p: int = 8,
                and o.step == 1 else o for o in ops]
     elif kind == "lost-block":
         ops = [o for o in ops if not (o.op == "compute"
-                                      and o.step == p - 1)]
+                                      and o.step == last)]
     elif kind == "malformed":
         ops.append(SchedOp("compute", 1))
     else:
@@ -216,39 +233,47 @@ def mutated_schedule(kind: str, p: int = 8,
 
 def describe(p: int, depth: int = 2,
              payload_shape: Optional[Tuple[int, ...]] = None,
-             dtype: Any = None, wire: str = "native") -> Dict[str, Any]:
+             dtype: Any = None, wire: str = "native",
+             subblocks: int = 1) -> Dict[str, Any]:
     """One ring exchange, fully described: the byte accounting from
-    ``transpose.ring_schedule`` (at this ``depth``), the generated
-    revolving timeline, and its hazard verdict — what ``dfft-verify``'s
-    schedule section and ``dfft-explain``'s graph section both print."""
+    ``transpose.ring_schedule`` (at this ``depth``/``subblocks``), the
+    generated revolving timeline, and its hazard verdict — what
+    ``dfft-verify``'s schedule section and ``dfft-explain``'s graph
+    section both print."""
     from ..parallel.transpose import ring_schedule
 
-    timeline = revolving_schedule(p, depth)
-    hazards = check_schedule(timeline, p, depth)
-    # A ring of p ranks has only p-1 steps, so at most p-1 buffers can
-    # ever be live — revolving_schedule caps there. Report the depth
-    # actually exercised so "depth 8 proven" is never claimed on a mesh
-    # too small to use an 8th buffer.
-    steps = max(0, p - 1)
+    timeline = revolving_schedule(p, depth, subblocks)
+    hazards = check_schedule(timeline, p, depth, subblocks)
+    # A ring of p ranks has only (p-1)*subblocks micro-steps, so at
+    # most that many buffers can ever be live — revolving_schedule caps
+    # there. Report the depth actually exercised so "depth 8 proven" is
+    # never claimed on a mesh too small to use an 8th buffer.
+    micro = max(0, p - 1) * max(1, subblocks)
     out: Dict[str, Any] = {
-        "p": p, "depth": depth,
-        "effective_depth": min(depth, steps) if steps else 0,
+        "p": p, "depth": depth, "subblocks": max(1, subblocks),
+        "effective_depth": min(depth, micro) if micro else 0,
         "timeline_ops": len(timeline),
         "hazards": [str(h) for h in hazards],
         "ok": not hazards,
     }
     if payload_shape is not None and dtype is not None:
         out["bytes"] = ring_schedule(payload_shape, dtype, wire, p,
-                                     overlap=depth > 1, depth=depth)
+                                     overlap=depth > 1, depth=depth,
+                                     subblocks=subblocks)
     return out
 
 
 def verify_shipped_depths(p: int,
-                          depths: Tuple[int, ...] = (2, 4, 8)
+                          depths: Tuple[int, ...] = (2, 4, 8),
+                          subblock_splits: Tuple[int, ...] = (1, 2)
                           ) -> List[Dict[str, Any]]:
     """The acceptance sweep: the generalized RING_OVERLAP schedule must
-    check clean at every autotune-candidate depth for this mesh size
-    (plus the plain ring and the single-peer degenerate)."""
+    check clean at every autotune-candidate depth x sub-block split for
+    this mesh size (plus the plain ring and the single-peer
+    degenerate). One row per (depth, split) combo — a missing row in
+    the dfft-verify output means a shipped schedule went unproven."""
     out = [describe(1, 1), describe(p, 1)]
-    out.extend(describe(p, d) for d in depths)
+    for d in depths:
+        for s in subblock_splits:
+            out.append(describe(p, d, subblocks=s))
     return out

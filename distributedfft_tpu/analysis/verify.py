@@ -14,8 +14,8 @@ declarative contract (``analysis/contracts.py``), plus:
 * jaxpr dataflow lints per combo (``analysis/jaxprlint.py``);
 * the schedule hazard sweep (``analysis/schedverify.py``): the
   generalized revolving-buffer RING_OVERLAP schedule must check clean
-  at depths 2/4/8 for this mesh (plus the serial ring and the
-  single-peer degenerate);
+  at depths 2/4/8 x sub-block splits 1/2 for this mesh (plus the
+  serial ring and the single-peer degenerate);
 * zero-overhead-off fingerprint pins: obs enabled/disabled, fault spec
   set-then-unset, and ``guards="enforce"`` vs ``"check"`` compile to
   byte-identical (metadata-stripped) op graphs;
@@ -35,7 +35,8 @@ Mutation self-test (the verifier verifying itself)::
 Graph-defect mutations: ``drop-decode-node`` (a declared graph whose
 decode stage was deleted), ``phantom-exchange`` (a graph declaring an
 exchange the build never stages), ``hazard-schedule`` (a revolving
-schedule with a write-after-send hazard).
+schedule with a write-after-send hazard), ``hazard-subblock`` (the
+same hazard planted in a sub-block micro-step schedule).
 
 Examples::
 
@@ -54,7 +55,8 @@ import tempfile
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 MUTATIONS = ("drop-decode", "bogus-census", "flip-forbidden",
-             "drop-decode-node", "phantom-exchange", "hazard-schedule")
+             "drop-decode-node", "phantom-exchange", "hazard-schedule",
+             "hazard-subblock")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -64,10 +66,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--families", default="slab,pencil,batched",
                     help="comma list of plan families to verify")
     ap.add_argument("--renderings",
-                    default="a2a,opt1,p2p,streams,ring,ring_ovl,fused",
+                    default="a2a,opt1,p2p,streams,ring,ring_ovl,"
+                            "ring_ovl_d4,ring_ovl_d8,ring_sub2,a2a_pipe,"
+                            "fused",
                     help="comma list of exchange renderings (ring_ovl = "
                          "SendMethod.RING_OVERLAP, the double-buffered "
-                         "ring; fused = RING_OVERLAP + Config.fused_wire, "
+                         "ring; ring_ovl_d4/d8 = the depth-4/8 revolving-"
+                         "buffer variants; ring_sub2 = the overlapped ring "
+                         "with each peer block split into 2 sub-blocks; "
+                         "a2a_pipe = the software-pipelined all-to-all, "
+                         "2 chunked collectives on the realigned layout; "
+                         "fused = RING_OVERLAP + Config.fused_wire, "
                          "the fused Pallas wire kernels — active on the "
                          "bf16 wire cells, inert on native)")
     ap.add_argument("--wires", default="native,bf16",
@@ -127,6 +136,16 @@ def _config(rendering: str, wire: str, guards: str) -> Any:
         kw.update(send_method=pm.SendMethod.RING)
     elif rendering == "ring_ovl":
         kw.update(send_method=pm.SendMethod.RING_OVERLAP)
+    elif rendering == "ring_ovl_d4":
+        kw.update(send_method=pm.SendMethod.RING_OVERLAP, overlap_depth=4)
+    elif rendering == "ring_ovl_d8":
+        kw.update(send_method=pm.SendMethod.RING_OVERLAP, overlap_depth=8)
+    elif rendering == "ring_sub2":
+        kw.update(send_method=pm.SendMethod.RING_OVERLAP,
+                  overlap_subblocks=2)
+    elif rendering == "a2a_pipe":
+        kw.update(comm_method=pm.CommMethod.ALL2ALL, opt=1,
+                  overlap_subblocks=2)
     elif rendering == "fused":
         kw.update(send_method=pm.SendMethod.RING_OVERLAP, fused_wire=True)
     else:
@@ -413,7 +432,8 @@ def run_mutation(name: str, ndev: int) -> Dict[str, Any]:
             tr.wire_decode = real_decode
         return dict(mutation=name, violations=violations,
                     expect="unpaired wire_encode/wire_decode")
-    if name in ("drop-decode-node", "phantom-exchange", "hazard-schedule"):
+    if name in ("drop-decode-node", "phantom-exchange", "hazard-schedule",
+                "hazard-subblock"):
         return _run_graph_mutation(name, ndev)
     plan, dims = _make_plan("slab", "opt1", "native", "off", "ZY_Then_X",
                             ndev)
@@ -451,13 +471,19 @@ def _run_graph_mutation(name: str, ndev: int) -> Dict[str, Any]:
 
     from . import plangraph, schedverify
 
-    if name == "hazard-schedule":
+    if name in ("hazard-schedule", "hazard-subblock"):
         # A revolving schedule that funnels every issue into buffer 0
         # while claiming depth 2: the second issue overwrites a live
         # block — the checker must name the hazard class.
+        # ``hazard-subblock`` mutates the SUB-BLOCK micro-step schedule
+        # (each peer block split in 2), proving the checker's coverage
+        # extends to the block-granularity axis, not just whole blocks.
+        sub = 2 if name == "hazard-subblock" else 1
         bad = schedverify.mutated_schedule("write-after-send",
-                                           p=max(3, ndev), depth=2)
-        hazards = schedverify.check_schedule(bad, max(3, ndev), 2)
+                                           p=max(3, ndev), depth=2,
+                                           subblocks=sub)
+        hazards = schedverify.check_schedule(bad, max(3, ndev), 2,
+                                             subblocks=sub)
         return dict(mutation=name,
                     violations=[str(h) for h in hazards],
                     expect="write-after-send")
@@ -598,8 +624,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             failures += 1
         eff = sched.get("effective_depth", sched["depth"])
         cap = f" (effective {eff})" if eff != sched["depth"] else ""
+        sub = sched.get("subblocks", 1)
         print(f"sched ring p={sched['p']:<3} depth={sched['depth']:<3}"
-              f"{cap} ({sched['timeline_ops']} op(s)) {status}")
+              f"sub={sub:<3}{cap} ({sched['timeline_ops']} op(s)) "
+              f"{status}")
         for h in sched["hazards"]:
             print(f"    {h}")
 

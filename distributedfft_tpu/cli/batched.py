@@ -31,8 +31,9 @@ import argparse
 import sys
 
 from .common import (add_common_args, maybe_autotune_comm,
-                     resilience_config_kwargs, run_testcase, setup_backend,
-                     wire_config_kwargs, wisdom_config_kwargs)
+                     overlap_config_kwargs, resilience_config_kwargs,
+                     run_testcase, setup_backend, wire_config_kwargs,
+                     wisdom_config_kwargs)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -75,8 +76,8 @@ def main(argv=None) -> int:
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
         fft_backend=args.fft_backend, streams_chunks=args.streams_chunks,
-        **wire_config_kwargs(args), **wisdom_config_kwargs(args),
-        **resilience_config_kwargs(args))
+        **overlap_config_kwargs(args), **wire_config_kwargs(args),
+        **wisdom_config_kwargs(args), **resilience_config_kwargs(args))
     if getattr(args, "autotune_comm", False):
         if args.shard != "x":
             print("autotune-comm: shard='batch' issues no collectives; "

@@ -148,6 +148,23 @@ def add_common_args(ap: argparse.ArgumentParser, pencil: bool = False,
                     help="piece count for the Streams pipelined transpose "
                          "(default 4; ignored unless a send method is "
                          "Streams)")
+    ap.add_argument("--overlap-depth", default="auto",
+                    help="revolving receive-buffer depth of the overlapped "
+                         "exchange schedules (RingOverlap and the pipelined "
+                         "all-to-all): up to depth-1 transfers are issued "
+                         "ahead of the compute consuming them (capped at "
+                         "ranks-1 ring steps). 2 | 4 | 8 | 'auto' (default: "
+                         "the comm race / wisdom picks when the comm choice "
+                         "is 'auto', else the shipped double-buffered "
+                         "depth 2)")
+    ap.add_argument("--overlap-subblocks", type=int, default=None,
+                    help="split every exchanged peer block into this many "
+                         "sub-blocks so the first sub-block's compute "
+                         "starts before the whole block arrives (default "
+                         "1 = whole blocks). With a Sync/MPI_Type send on "
+                         "All2All, >1 selects the software-pipelined "
+                         "all-to-all rendering (a2a_pipe) instead of the "
+                         "monolithic collective")
     ap.add_argument("--wire-dtype", "-wire",
                     default=os.environ.get("DFFT_WIRE", "native"),
                     choices=("native", "bf16", "auto"),
@@ -209,6 +226,18 @@ def wire_config_kwargs(args) -> dict:
     return {"wire_dtype": pm.parse_wire_dtype(
                 getattr(args, "wire_dtype", "native")),
             "wire_error_budget": getattr(args, "wire_error_budget", None)}
+
+
+def overlap_config_kwargs(args) -> dict:
+    """Config kwargs carrying the CLI overlap surface (--overlap-depth /
+    --overlap-subblocks; shared by all four executables). Defaults
+    reproduce the shipped schedules exactly: depth 'auto' resolves to the
+    double-buffered depth 2 outside a race, and no sub-block split keeps
+    whole-block exchanges."""
+    from .. import params as pm
+    return {"overlap_depth": pm.parse_overlap_depth(
+                getattr(args, "overlap_depth", "auto")),
+            "overlap_subblocks": getattr(args, "overlap_subblocks", None)}
 
 
 def resilience_config_kwargs(args) -> dict:

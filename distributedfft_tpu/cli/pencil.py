@@ -14,8 +14,9 @@ import argparse
 import sys
 
 from .common import (add_common_args, maybe_autotune_comm,
-                     resilience_config_kwargs, run_testcase, setup_backend,
-                     wire_config_kwargs, wisdom_config_kwargs)
+                     overlap_config_kwargs, resilience_config_kwargs,
+                     run_testcase, setup_backend, wire_config_kwargs,
+                     wisdom_config_kwargs)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -51,8 +52,8 @@ def main(argv=None) -> int:
         warmup_rounds=args.warmup_rounds, iterations=args.iterations,
         double_prec=args.double_prec, benchmark_dir=args.benchmark_dir,
         fft_backend=args.fft_backend, streams_chunks=args.streams_chunks,
-        **wire_config_kwargs(args), **wisdom_config_kwargs(args),
-        **resilience_config_kwargs(args))
+        **overlap_config_kwargs(args), **wire_config_kwargs(args),
+        **wisdom_config_kwargs(args), **resilience_config_kwargs(args))
     part = pm.PencilPartition(args.partition1, args.partition2)
     cfg = maybe_autotune_comm(args, "pencil", g, part, cfg,
                               dims=args.fft_dim)

@@ -173,10 +173,12 @@ def _dispatch(args, shape, dtype, it, wu) -> int:
         from ..models.slab import SlabFFTPlan
 
         g = pm.GlobalSize(*shape)
+        from .common import overlap_config_kwargs
         plan = SlabFFTPlan(g, pm.SlabPartition(p),
                            pm.Config(comm_method=pm.CommMethod.ALL2ALL,
                                      double_prec=args.double_prec,
-                                     guards=getattr(args, "guards", None)))
+                                     guards=getattr(args, "guards", None),
+                                     **overlap_config_kwargs(args)))
         x = plan.pad_input(np.random.default_rng(0).random(g.shape)
                            .astype(dtype))
         spec = plan.forward_stages()[0][1](x)

@@ -34,20 +34,27 @@ import numpy as np
 
 from ..utils.timer import read_timer_csv
 
-# Slab: test_<opt>_<comm>_<snd>_<Nx>_<Ny>_<Nz>_<cuda>_<P>[_w<wire>].csv
+# Slab: test_<opt>_<comm>_<snd>_<Nx>_<Ny>_<Nz>_<cuda>_<P>
+#       [_d<depth>][_s<sub>][_w<wire>].csv
 # Pencil: test_<opt>_<comm1>_<snd1>_<comm2>_<snd2>_<Nx>_<Ny>_<Nz>_<cuda>
-#         _<P1>_<P2>[_w<wire>].csv
+#         _<P1>_<P2>[_d<depth>][_s<sub>][_w<wire>].csv
 # The optional _w<code> token is the wire-dtype extension (utils/timer
 # _WIRE_CODE; native omits it, keeping legacy names byte-for-byte) —
 # non-native wires reduce as their own variant rows, like the batched2d
-# _ck chunk variants, so compressed and native runs never merge.
+# _ck chunk variants, so compressed and native runs never merge. The
+# _d<depth>/_s<sub> tokens are the overlap-schedule extension on the same
+# pattern (utils/timer._overlap_suffix; the shipped depth-2/whole-block
+# schedules omit them): each depth/sub-block combination reduces as its
+# own variant row too.
 _SLAB_FILE_RE = re.compile(
     r"test_(?P<opt>\d+)_(?P<comm>\d+)_(?P<snd>\d+)_(?P<nx>\d+)_(?P<ny>\d+)"
-    r"_(?P<nz>\d+)_(?P<cuda>\d+)_(?P<p>\d+)(?:_w(?P<wire>\d+))?\.csv$")
+    r"_(?P<nz>\d+)_(?P<cuda>\d+)_(?P<p>\d+)(?:_d(?P<depth>\d+))?"
+    r"(?:_s(?P<sub>\d+))?(?:_w(?P<wire>\d+))?\.csv$")
 _PENCIL_FILE_RE = re.compile(
     r"test_(?P<opt>\d+)_(?P<comm>\d+)_(?P<snd>\d+)_(?P<comm2>\d+)"
     r"_(?P<snd2>\d+)_(?P<nx>\d+)_(?P<ny>\d+)_(?P<nz>\d+)_(?P<cuda>\d+)"
-    r"_(?P<p1>\d+)_(?P<p2>\d+)(?:_w(?P<wire>\d+))?\.csv$")
+    r"_(?P<p1>\d+)_(?P<p2>\d+)(?:_d(?P<depth>\d+))?(?:_s(?P<sub>\d+))?"
+    r"(?:_w(?P<wire>\d+))?\.csv$")
 
 _COMM_NAMES = {0: "Peer2Peer", 1: "All2All"}
 # 3/4 = the RING / RING_OVERLAP extensions, 0-2 the reference's own codes
@@ -77,6 +84,14 @@ def _variant_label(variant: str):
         fam, flavor = _variant_label(base)
         wire = _WIRE_NAMES.get(int(w), f"wire{w}")
         return fam, f"{flavor} wire={wire}".strip()
+    base, sep, sub = variant.rpartition("_s")
+    if sep and sub.isdigit():
+        fam, flavor = _variant_label(base)
+        return fam, f"{flavor} subblocks={sub}".strip()
+    base, sep, depth = variant.rpartition("_d")
+    if sep and depth.isdigit():
+        fam, flavor = _variant_label(base)
+        return fam, f"{flavor} depth={depth}".strip()
     base, sep, ck = variant.rpartition("_ck")
     if sep and ck.isdigit() and base in _VARIANT_LABELS:
         fam, flavor = _VARIANT_LABELS[base]
@@ -120,9 +135,16 @@ def scan(prefix: str) -> Dict:
             key = (g["opt"], comm, snd, g["cuda"], p)
             # Non-native wires reduce as their own variant (the CSV schema
             # keeps them in separate files; merging them into the native
-            # rows would average lossy and lossless runs).
-            wire = g.get("wire", 0)
-            vkey = (f"{variant}_w{wire}" if wire else variant)
+            # rows would average lossy and lossless runs). Overlap
+            # depth/sub-block variants follow the same rule — each timed
+            # schedule stays its own row.
+            vkey = variant
+            if g.get("depth"):
+                vkey += f"_d{g['depth']}"
+            if g.get("sub"):
+                vkey += f"_s{g['sub']}"
+            if g.get("wire"):
+                vkey += f"_w{g['wire']}"
             data[vkey][key][size] = read_timer_csv(os.path.join(vdir, fname))
     return data
 

@@ -34,8 +34,9 @@ from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
-                                  concat_axis_chunks,
-                                  pad_axis_to, ring_transpose, slice_axis_to,
+                                  concat_axis_chunks, pad_axis_to,
+                                  pipelined_all_to_all, ring_subblocks,
+                                  ring_transpose, slice_axis_to,
                                   split_axis_chunks, wire_gspmd_stages)
 from ..utils import wisdom
 from .base import _with_pad, jit_stages, notice_axis_smoothness
@@ -460,6 +461,8 @@ class Batched2DFFTPlan:
         if self.config.send_method.is_ring:
             split, concat = (2, 1) if forward else (1, 2)
             overlap = self.config.send_method is pm.SendMethod.RING_OVERLAP
+            depth = self.config.resolved_overlap_depth()
+            subblocks = self.config.resolved_overlap_subblocks()
             from ..ops import pallas_fft as plf
             enc_fn, arr_fn = plf.fused_ring_hooks(self.config)
 
@@ -467,7 +470,8 @@ class Batched2DFFTPlan:
                 with obs.profile.stage_scope("batched2d", "exchange:1"):
                     y = ring_transpose(first(v), SLAB_AXIS, split,
                                        concat, wire=wire,
-                                       overlap=overlap,
+                                       overlap=overlap, depth=depth,
+                                       subblocks=subblocks,
                                        encode_fn=enc_fn,
                                        arrive_fn=arr_fn)
                 return last(y)
@@ -484,6 +488,22 @@ class Batched2DFFTPlan:
                     return concat_axis_chunks(
                         [last(xpose(p))
                          for p in split_axis_chunks(c, 0, k)], 0)
+            elif self.config.resolved_overlap_subblocks() > 1:
+                # a2a_pipe: the software-pipelined monolithic exchange,
+                # chunked along the untouched batch axis (chunk k+1's
+                # collective issued while chunk k decodes).
+                split, concat = (2, 1) if forward else (1, 2)
+                realigned = self.config.opt == 1
+                pk = self.config.resolved_overlap_subblocks()
+                depth = self.config.resolved_overlap_depth()
+
+                def body(v):
+                    with obs.profile.stage_scope("batched2d", "exchange:1"):
+                        y = pipelined_all_to_all(
+                            first(v), SLAB_AXIS, split, concat,
+                            chunk_axis=0, chunks=pk, depth=depth,
+                            realigned=realigned, wire=wire)
+                    return last(y)
             else:
                 def body(v):
                     return last(xpose(first(v)))
@@ -601,18 +621,31 @@ def _contract_exchanges(plan, direction, dims=2):
     gather x; STREAMS chunks along the untouched batch axis);
     ``shard="batch"`` and the single-device fallback are collective-free
     by construction."""
-    del direction, dims
+    del dims
     if plan.fft3d or plan.shard == "batch":
         return ()
     from ..analysis import contracts as _c
     cfg = plan.config
     rendering = _c.rendering_name(cfg)
     chunks = 1
+    subblocks = 1
     if rendering == "streams":
         chunks = min(cfg.resolved_streams_chunks(), plan._batch_pad)
+    elif rendering == "a2a_pipe":
+        chunks = ring_subblocks(plan._batch_pad,
+                                cfg.resolved_overlap_subblocks())
+    elif rendering in ("ring", "ring_overlap"):
+        # The sub-block split slices arriving blocks along the concat
+        # axis: forward gathers x (local extent nx_pad/P), inverse
+        # gathers spectral y (nys_pad/P).
+        p = plan.partition.num_ranks
+        ext = (plan._nx_pad // p if direction == "forward"
+               else plan._nys_pad // p)
+        subblocks = ring_subblocks(ext, cfg.resolved_overlap_subblocks())
     return (_c.ExchangeDecl(
         "transpose", (plan._batch_pad, plan._nx_pad, plan._nys_pad),
-        plan.partition.num_ranks, rendering, chunks),)
+        plan.partition.num_ranks, rendering, chunks,
+        subblocks=subblocks),)
 
 
 def _declare_graph(plan, direction, dims=2):
@@ -643,10 +676,11 @@ def _declare_graph(plan, direction, dims=2):
     else:
         (decl,) = _contract_exchanges(plan, direction)
         b.node("local_fft", axes=(2,) if fwd else (1,), label="stage 1")
-        depth = _pg.shipped_schedule_depth(decl.rendering)
+        depth = _pg.shipped_schedule_depth(decl.rendering, cfg)
         fused = cfg.fused_wire_active()
         b.exchange(decl.label, decl.payload_shape, decl.axis_size,
                    decl.rendering, chunks=decl.chunks,
+                   subblocks=decl.subblocks,
                    schedule_depth=depth, decoded_spec=out_spec,
                    fused_encode=fused,
                    decode_fuses=("decode",) if fused else None)

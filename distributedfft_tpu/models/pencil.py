@@ -55,9 +55,11 @@ from ..ops import fft as lf
 from ..parallel.mesh import PENCIL_AXES, make_pencil_mesh
 from ..parallel.transpose import (WIRE_NATIVE, all_to_all_transpose,
                                   chunked_reshard, concat_axis_chunks,
-                                  pad_axis_to, ring_transpose, slice_axis_to,
-                                  split_axis_chunks, wire_complex_dtype,
-                                  wire_decode, wire_encode)
+                                  pad_axis_to, pipelined_all_to_all,
+                                  ring_subblocks, ring_transpose,
+                                  slice_axis_to, split_axis_chunks,
+                                  wire_complex_dtype, wire_decode,
+                                  wire_encode)
 from ..resilience import inject
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad, notice_axis_smoothness
@@ -706,6 +708,8 @@ class PencilFFTPlan(DistFFTPlan):
             axis_name, split, concat = xinfo
             wire = self.config.wire_dtype
             overlap = snd is pm.SendMethod.RING_OVERLAP
+            depth = self.config.resolved_overlap_depth()
+            subblocks = self.config.resolved_overlap_subblocks()
             from ..ops import pallas_fft as plf
             enc_fn, arr_fn = plf.fused_ring_hooks(self.config, snd)
 
@@ -715,6 +719,7 @@ class PencilFFTPlan(DistFFTPlan):
                 with obs.profile.stage_scope("pencil", scope_id):
                     return ring_transpose(f(c), axis_name, split, concat,
                                           wire=wire, overlap=overlap,
+                                          depth=depth, subblocks=subblocks,
                                           encode_fn=enc_fn,
                                           arrive_fn=arr_fn)
 
@@ -734,6 +739,26 @@ class PencilFFTPlan(DistFFTPlan):
 
                 segments[-1] = (seg, spec_after)
                 return True
+            if self.config.resolved_overlap_subblocks() > 1:
+                # ALL2ALL + SYNC/MPI_TYPE with a sub-block split: the
+                # software-pipelined monolithic exchange (a2a_pipe) —
+                # chunk k+1's collective issued while chunk k decodes,
+                # along the same free axis STREAMS chunks.
+                axis_name, split, concat = xinfo
+                wire = self.config.wire_dtype
+                realigned = self.config.opt == 1
+                pk = self.config.resolved_overlap_subblocks()
+                depth = self.config.resolved_overlap_depth()
+
+                def pseg(c, f=prev_fn):
+                    with obs.profile.stage_scope("pencil", scope_id):
+                        return pipelined_all_to_all(
+                            f(c), axis_name, split, concat, chunk_axis=ca,
+                            chunks=pk, depth=depth, realigned=realigned,
+                            wire=wire)
+
+                segments[-1] = (pseg, spec_after)
+                return False
             segments[-1] = (lambda c, f=prev_fn: a2a(f(c)), spec_after)
             return False
         # PEER2PEER boundaries: when the wire compresses, the break carries
@@ -926,30 +951,50 @@ def _contract_exchanges(plan, direction, dims=3):
     (scatter y, gather x; free axis z, chunk axis 2 sharded over p2)
     from dims >= 3. Payloads are the padded spectral volumes both
     transposes move (``spec_for`` shapes)."""
-    del direction  # both transposes run (mirrored) in both directions
+    # Both transposes run (mirrored) in both directions; only the ring
+    # sub-block split is direction-dependent — the concat axis (the one
+    # the arriving blocks slice along) flips with the direction.
     if plan.fft3d:
         return ()
     from ..analysis import contracts as _c
     cfg = plan.config
+    fwd = direction == "forward"
+    sub = cfg.resolved_overlap_subblocks()
     out = []
     if dims >= 2 and plan.p2 > 1:
         r1 = _c.rendering_name(cfg)
-        k1 = 1
+        k1 = s1 = 1
         if r1 == "streams":
             k1 = min(cfg.resolved_streams_chunks(),
                      plan._nx_p1 // plan.p1)
+        elif r1 == "a2a_pipe":
+            k1 = ring_subblocks(plan._nx_p1 // plan.p1, sub)
+        elif r1 in ("ring", "ring_overlap"):
+            # Forward t1 gathers y (concat 1); inverse t1b gathers z
+            # (concat 2). Local extents, same clamp as ring_transpose.
+            ext = (plan._ny_p2 // plan.p2 if fwd
+                   else plan._nzc_p2 // plan.p2)
+            s1 = ring_subblocks(ext, sub)
         out.append(_c.ExchangeDecl(
             "transpose 1", (plan._nx_p1, plan._ny_p2, plan._nzc_p2),
-            plan.p2, r1, k1))
+            plan.p2, r1, k1, subblocks=s1))
     if dims >= 3 and plan.p1 > 1:
         r2 = _c.rendering_name(cfg, second=True)
-        k2 = 1
+        k2 = s2 = 1
         if r2 == "streams":
             k2 = min(cfg.resolved_streams_chunks(),
                      plan._nzc_p2 // plan.p2)
+        elif r2 == "a2a_pipe":
+            k2 = ring_subblocks(plan._nzc_p2 // plan.p2, sub)
+        elif r2 in ("ring", "ring_overlap"):
+            # Forward t2 gathers x (concat 0); inverse t2b gathers y
+            # (concat 1).
+            ext = (plan._nx_p1 // plan.p1 if fwd
+                   else plan._ny_p1 // plan.p1)
+            s2 = ring_subblocks(ext, sub)
         out.append(_c.ExchangeDecl(
             "transpose 2", (plan._nx_p1, plan._ny_p1, plan._nzc_p2),
-            plan.p1, r2, k2))
+            plan.p1, r2, k2, subblocks=s2))
     return tuple(out)
 
 
@@ -975,8 +1020,9 @@ def _declare_graph(plan, direction, dims=3):
             return
         fused = cfg.fused_wire_active(second)
         b.exchange(d.label, d.payload_shape, d.axis_size, d.rendering,
-                   chunks=d.chunks,
-                   schedule_depth=_pg.shipped_schedule_depth(d.rendering),
+                   chunks=d.chunks, subblocks=d.subblocks,
+                   schedule_depth=_pg.shipped_schedule_depth(d.rendering,
+                                                             cfg),
                    decoded_spec=spec_after, fused_encode=fused,
                    decode_fuses=("decode",) if fused else None)
 

@@ -61,8 +61,9 @@ from .. import params as pm
 from ..ops import fft as lf
 from ..parallel.mesh import SLAB_AXIS, make_slab_mesh
 from ..parallel.transpose import (all_to_all_transpose, chunked_reshard,
-                                  concat_axis_chunks,
-                                  pad_axis_to, ring_transpose, slice_axis_to,
+                                  concat_axis_chunks, pad_axis_to,
+                                  pipelined_all_to_all, ring_subblocks,
+                                  ring_transpose, slice_axis_to,
                                   split_axis_chunks, wire_gspmd_stages)
 from ..utils import wisdom
 from .base import DistFFTPlan, _with_pad, notice_axis_smoothness
@@ -319,6 +320,21 @@ class SlabFFTPlan(DistFFTPlan):
         ``split_axis`` <-> 0, leaving exactly one of {1, 2} free)."""
         return next(a for a in (1, 2) if a != self._seq.split_axis)
 
+    def _a2a_pipe_chunks(self) -> int:
+        """Resolved chunk count of the software-pipelined monolithic
+        all-to-all (rendering ``a2a_pipe``: ALL2ALL + SYNC/MPI_TYPE with
+        ``Config.overlap_subblocks`` > 1), clamped to the free-axis
+        extent; 1 whenever another rendering owns the exchange."""
+        cfg = self.config
+        if (self.fft3d
+                or cfg.comm_method is not pm.CommMethod.ALL2ALL
+                or cfg.send_method not in (pm.SendMethod.SYNC,
+                                           pm.SendMethod.MPI_TYPE)):
+            return 1
+        ca = self._streams_chunk_axis()
+        return ring_subblocks(self.output_padded_shape[ca],
+                              cfg.resolved_overlap_subblocks())
+
     def _xpose_bodies(self, realigned=None, chunks: Optional[int] = None,
                       wire: Optional[str] = None):
         """The pipeline's own transpose bodies ``(forward, inverse)`` for a
@@ -349,6 +365,23 @@ class SlabFFTPlan(DistFFTPlan):
             with obs.profile.stage_scope("slab", "exchange:1"):
                 return all_to_all_transpose(cl, SLAB_AXIS, split, concat,
                                             realigned=realigned, wire=wire)
+
+        if chunks is None and self._a2a_pipe_chunks() > 1:
+            # ALL2ALL + SYNC/MPI_TYPE with a sub-block split: the
+            # software-pipelined monolithic exchange (chunk k+1's
+            # collective issued while chunk k decodes) along the one
+            # free axis — opt0/opt1 overlap without switching to ring.
+            pk = self._a2a_pipe_chunks()
+            depth = self.config.resolved_overlap_depth()
+
+            def piped(cl, split, concat):
+                with obs.profile.stage_scope("slab", "exchange:1"):
+                    return pipelined_all_to_all(
+                        cl, SLAB_AXIS, split, concat, chunk_axis=ca,
+                        chunks=pk, depth=depth, realigned=realigned,
+                        wire=wire)
+
+            return (lambda cl: piped(cl, sa, 0)), (lambda cl: piped(cl, 0, sa))
 
         if chunks is None or chunks <= 1:
             return (lambda cl: one(cl, sa, 0)), (lambda cl: one(cl, 0, sa))
@@ -597,12 +630,15 @@ class SlabFFTPlan(DistFFTPlan):
         sa, nx = s.split_axis, g.nx
         wire = self.config.wire_dtype
         overlap = self._ring_overlap()
+        depth = self.config.resolved_overlap_depth()
+        subblocks = self.config.resolved_overlap_subblocks()
 
         def body(xl):
             with obs.profile.stage_scope("slab", "exchange:1"):
                 y = ring_transpose(first(xl), SLAB_AXIS, sa, 0,
                                    pipeline_fn=pipe, wire=wire,
-                                   overlap=overlap, encode_fn=enc_fn,
+                                   overlap=overlap, depth=depth,
+                                   subblocks=subblocks, encode_fn=enc_fn,
                                    arrive_fn=arr_fn)
             with obs.profile.stage_scope("slab", "local_fft:2"):
                 y = slice_axis_to(y, 0, nx)
@@ -635,12 +671,15 @@ class SlabFFTPlan(DistFFTPlan):
         after = tuple(a for a in reversed(s.pre_axes) if a == sa)
         wire = self.config.wire_dtype
         overlap = self._ring_overlap()
+        depth = self.config.resolved_overlap_depth()
+        subblocks = self.config.resolved_overlap_subblocks()
 
         def body(cl):
             with obs.profile.stage_scope("slab", "exchange:1"):
                 y = ring_transpose(first(cl), SLAB_AXIS, 0, sa,
                                    pipeline_fn=pipe, wire=wire,
-                                   overlap=overlap, encode_fn=enc_fn,
+                                   overlap=overlap, depth=depth,
+                                   subblocks=subblocks, encode_fn=enc_fn,
                                    arrive_fn=arr_fn)
             with obs.profile.stage_scope("slab", "local_fft:2"):
                 y = slice_axis_to(y, sa, split_ext)
@@ -896,8 +935,11 @@ class SlabFFTPlan(DistFFTPlan):
 def _contract_exchanges(plan, direction, dims=3):
     """Slab: one symmetric global exchange per direction (scatter the
     sequence's split axis, gather x), payload = the padded spectral
-    volume. The single-device fallback stages none."""
-    del direction, dims  # the slab exchange is direction-symmetric
+    volume. The single-device fallback stages none. The payload and
+    rendering are direction-symmetric; only the ring sub-block split
+    depends on ``direction`` (the concat axis — and hence the extent
+    the split clamps to — flips with it)."""
+    del dims
     if plan.fft3d:
         return ()
     from ..analysis import contracts as _c
@@ -909,13 +951,25 @@ def _contract_exchanges(plan, direction, dims=3):
     payload = list(plan.output_padded_shape)
     payload[0] = plan._nx_pad
     chunks = 1
+    subblocks = 1
     if rendering == "streams":
         # chunk_slices clamps the piece count to the free-axis extent at
         # trace time; mirror it so the expected all-to-all count is exact.
         ca = plan._streams_chunk_axis()
         chunks = min(cfg.resolved_streams_chunks(), payload[ca])
+    elif rendering == "a2a_pipe":
+        chunks = plan._a2a_pipe_chunks()
+    elif rendering in ("ring", "ring_overlap"):
+        # The sub-block split slices arriving blocks along the concat
+        # axis (forward gathers x = axis 0, inverse gathers the split
+        # axis); ring_subblocks applies the same trace-time clamp as
+        # ring_transpose, on the LOCAL (per-rank) extent.
+        c = 0 if direction == "forward" else plan._seq.split_axis
+        subblocks = ring_subblocks(payload[c] // plan._P,
+                                   cfg.resolved_overlap_subblocks())
     return (_c.ExchangeDecl("transpose", tuple(payload),
-                            plan._P, rendering, chunks),)
+                            plan._P, rendering, chunks,
+                            subblocks=subblocks),)
 
 
 def _declare_graph(plan, direction, dims=3):
@@ -957,11 +1011,12 @@ def _declare_graph(plan, direction, dims=3):
             if c2c and s.r2c_axis != s.split_axis:
                 pipe_axes += (s.r2c_axis,)
         b.node("local_fft", axes=stage1, label="stage 1")
-        depth = _pg.shipped_schedule_depth(decl.rendering)
+        depth = _pg.shipped_schedule_depth(decl.rendering, cfg)
         fused = cfg.fused_wire_active()
         spec_after = plan.output_spec if fwd else plan.input_spec
         b.exchange(decl.label, decl.payload_shape, decl.axis_size,
                    decl.rendering, chunks=decl.chunks,
+                   subblocks=decl.subblocks,
                    schedule_depth=depth, decoded_spec=spec_after,
                    fused_encode=fused,
                    decode_fuses=(("decode", "fft") if pipe_axes

@@ -82,6 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--send-method2", "-snd2", default=None)
     ap.add_argument("--opt", "-o", type=int, default=0, choices=(0, 1))
     ap.add_argument("--streams-chunks", type=int, default=None)
+    ap.add_argument("--overlap-depth", default="auto",
+                    help="revolving-buffer depth for RingOverlap (2|4|8 or "
+                         "'auto'; capped at ranks-1 micro-steps — the "
+                         "schedule block reports the effective depth)")
+    ap.add_argument("--overlap-subblocks", type=int, default=None,
+                    help="split each peer block into this many sub-blocks "
+                         "(rings) / pipeline the all-to-all in this many "
+                         "chunks (All2All + Sync/MpiType)")
     ap.add_argument("--wire-dtype", "-wire", default="native",
                     choices=("native", "bf16", "auto"))
     ap.add_argument("--wire-error-budget", type=float, default=None)
@@ -130,28 +138,48 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 
-def _rendering(comm, send, opt, p: int, fused_wire: bool = False) -> str:
-    """One-line resolved rendering of a single transpose."""
+def _rendering(comm, send, opt, p: int, fused_wire: bool = False,
+               depth: int = 2, subblocks: int = 1) -> str:
+    """One-line resolved rendering of a single transpose. ``depth`` /
+    ``subblocks`` are the resolved overlap knobs — they pick the
+    revolving-buffer ring wording (with the effective P-1 cap spelled
+    out) and the pipelined all-to-all rendering."""
     from .. import params as pm
+    sub = (f", each peer block split into {subblocks} sub-blocks"
+           if subblocks > 1 else "")
     if send is pm.SendMethod.RING_OVERLAP:
         steps = f"{p - 1} distinct lax.ppermute step" \
             + ("s" if p > 2 else "")
         fused = (", fused Pallas wire kernels (encode-pack / decode+FFT)"
                  if fused_wire else "")
-        return (f"ring-overlap — {steps} on the DOUBLE-BUFFERED schedule "
-                "(step t+1's permute issued before block t's FFT; "
+        micro = max(0, p - 1) * max(1, subblocks)
+        buffers = min(depth, micro) if micro else 0
+        if depth == 2 and subblocks == 1:
+            return (f"ring-overlap — {steps} on the DOUBLE-BUFFERED "
+                    "schedule (step t+1's permute issued before block t's "
+                    f"FFT; bit-identical to Ring, reordered issue{fused})")
+        cap = (f" — depth {depth} capped at {buffers} by the "
+               f"{micro}-micro-step schedule" if buffers < depth else "")
+        return (f"ring-overlap — {steps} on the depth-{depth} "
+                f"REVOLVING-BUFFER schedule ({buffers} receive buffer"
+                f"{'s' if buffers != 1 else ''} in flight{cap}{sub}; "
                 f"bit-identical to Ring, reordered issue{fused})")
     if send is pm.SendMethod.RING:
         steps = f"{p - 1} distinct lax.ppermute step" \
             + ("s" if p > 2 else "")
         return (f"ring — {steps} (owns the rendering regardless of comm; "
-                "per-block FFTs pipelined where axis roles allow)")
+                f"per-block FFTs pipelined where axis roles allow{sub})")
     layout = "realigned (opt1 pack, pure exchange)" if opt == 1 \
         else "default layout"
     if comm is pm.CommMethod.ALL2ALL:
         base = f"explicit shard_map lax.all_to_all, {layout}"
         if send is pm.SendMethod.STREAMS:
             return base + " — STREAMS: chunked into independent piece chains"
+        if subblocks > 1:
+            return (f"pipelined all-to-all — {subblocks} chunked "
+                    f"collectives, chunk k+1 issued while chunk k decodes "
+                    f"(revolving depth {depth}), {layout}; bit-identical "
+                    "to the monolithic exchange")
         return base
     base = f"GSPMD (Peer2Peer) stage-boundary reshard, {layout}"
     if send is pm.SendMethod.STREAMS:
@@ -184,22 +212,35 @@ def _wire_lines(shapes, cdt, cfg) -> list:
 
 
 def _schedule_lines(xmeta, cdt, cfg) -> list:
-    """Overlap-schedule block for ring-rendered exchanges (ISSUE 10):
-    blocks (= ring steps), revolving buffers, and the per-device wire
-    bytes in flight — ``transpose.ring_schedule`` over the exact padded
-    payload each exchange moves. Empty when no exchange is a ring."""
+    """Overlap-schedule block for ring-rendered exchanges (ISSUE 10/16):
+    blocks (= ring steps), sub-block split, EFFECTIVE revolving buffers
+    (the requested depth under the micro-step cap — depth 8 on 8 ranks
+    holds 7 and this block says so), and the per-device wire bytes in
+    flight for the chosen split — ``transpose.ring_schedule`` over the
+    exact padded payload each exchange moves. Empty when no exchange is
+    a ring."""
     from .. import params as pm
     from ..parallel.transpose import ring_schedule
+    depth = cfg.resolved_overlap_depth()
+    subblocks = cfg.resolved_overlap_subblocks()
     lines = []
     for label, shape, p, snd in xmeta:
         if not snd.is_ring:
             continue
+        overlap = snd is pm.SendMethod.RING_OVERLAP
         sch = ring_schedule(shape, cdt, cfg.wire_dtype, p,
-                            overlap=snd is pm.SendMethod.RING_OVERLAP)
+                            overlap=overlap, depth=depth,
+                            subblocks=subblocks)
+        split = ("" if sch["subblocks"] == 1 else
+                 f" split into {sch['subblocks']} sub-blocks of "
+                 f"{_fmt_bytes(sch['subblock_wire_bytes'])} "
+                 f"({sch['permutes']} permutes),")
+        cap = (f" (depth {depth} capped by the schedule)"
+               if overlap and sch["effective_depth"] < depth else "")
         lines.append(
             f"  {label}: {sch['steps']} block(s) of "
-            f"{_fmt_bytes(sch['block_wire_bytes'])} on the wire, "
-            f"{sch['buffers']} revolving buffer(s), "
+            f"{_fmt_bytes(sch['block_wire_bytes'])} on the wire,{split} "
+            f"{sch['buffers']} revolving buffer(s){cap}, "
             f"{_fmt_bytes(sch['bytes_in_flight'])} in flight per device "
             f"(mesh total {_fmt_bytes(sch['total_wire_bytes'])}, the "
             f"(P-1)/P ring discount)")
@@ -533,6 +574,8 @@ def main(argv=None) -> int:
         opt=args.opt, double_prec=args.double_prec,
         fft_backend=args.fft_backend,
         streams_chunks=args.streams_chunks,
+        overlap_depth=pm.parse_overlap_depth(args.overlap_depth),
+        overlap_subblocks=args.overlap_subblocks,
         wire_dtype=pm.parse_wire_dtype(args.wire_dtype),
         wire_error_budget=args.wire_error_budget,
         fused_wire=bool(args.fused_wire),
@@ -667,7 +710,10 @@ def main(argv=None) -> int:
                        f"{cfg.send_method.value} -> "
                        + _rendering(cfg.comm_method, cfg.send_method,
                                     cfg.opt, plan.p2,
-                                    cfg.fused_wire_active()))
+                                    cfg.fused_wire_active(),
+                                    depth=cfg.resolved_overlap_depth(),
+                                    subblocks=cfg
+                                    .resolved_overlap_subblocks()))
             if dims >= 3:
                 out.append(f"  transpose 2: comm "
                            f"{cfg.resolved_comm2().value} snd "
@@ -675,13 +721,19 @@ def main(argv=None) -> int:
                            + _rendering(cfg.resolved_comm2(),
                                         cfg.resolved_snd2(), cfg.opt,
                                         plan.p1,
-                                        cfg.fused_wire_active(True)))
+                                        cfg.fused_wire_active(True),
+                                        depth=cfg.resolved_overlap_depth(),
+                                        subblocks=cfg
+                                        .resolved_overlap_subblocks()))
         else:
             out.append(f"  comm {cfg.comm_method.value} snd "
                        f"{cfg.send_method.value} opt {cfg.opt} -> "
                        + _rendering(cfg.comm_method, cfg.send_method,
                                     cfg.opt, ranks,
-                                    cfg.fused_wire_active()))
+                                    cfg.fused_wire_active(),
+                                    depth=cfg.resolved_overlap_depth(),
+                                    subblocks=cfg
+                                    .resolved_overlap_subblocks()))
         out.append(f"  local FFT backend: {cfg.fft_backend}"
                    + (f" (mxu_precision={cfg.mxu_precision}, "
                       f"mxu_direct_max={cfg.mxu_direct_max})"

@@ -276,9 +276,19 @@ def _chunked_reshard_impl(x, target, axis: int, k: int):
     return jnp.reshape(concat_axis_chunks(pieces, axis + 1), x.shape)
 
 
+def ring_subblocks(concat_extent: int, subblocks: int) -> int:
+    """Effective sub-block count of a ring exchange: the requested split
+    clamped to the travelling block's concat-axis extent (``chunk_slices``
+    semantics). The ONE clamp the transpose, the contract decls and the
+    schedule descriptors all share, so the traced permute count and the
+    declared census can never disagree."""
+    return len(chunk_slices(max(1, int(concat_extent)), max(1, subblocks)))
+
+
 def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
                    pipeline_fn=None, wire: str = WIRE_NATIVE,
-                   overlap: bool = False, encode_fn=None, arrive_fn=None):
+                   overlap: bool = False, depth: int = 2, subblocks: int = 1,
+                   encode_fn=None, arrive_fn=None):
     """Ring-pipelined rendering of the tiled ``lax.all_to_all`` exchange:
     the global transpose decomposed into ``P-1`` ``lax.ppermute`` steps
     (rotation offset t sends the block destined for peer ``r+t`` directly,
@@ -316,16 +326,33 @@ def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
     both satisfy the same per-element error bound, the ring merely keeps
     1/P of the data lossless for free.
 
-    ``overlap`` selects the DOUBLE-BUFFERED schedule
-    (``SendMethod.RING_OVERLAP``): step t+1's ``ppermute`` is issued
-    before block t's ``pipeline_fn`` is traced, with two revolving
-    buffers (the in-flight block and the computing block). Every
-    per-block op — slice, encode, taint, permute, decode, pipeline — is
-    IDENTICAL to the ``overlap=False`` schedule, only the issue order
-    changes, so the output is bit-identical to RING while a scheduler
-    that honors program order (the TPU async start/done lowering) can
-    keep one wire transfer in flight under every block's compute
-    instead of alternating permute -> FFT -> permute.
+    ``overlap`` selects the REVOLVING-BUFFER schedule
+    (``SendMethod.RING_OVERLAP``): up to ``depth - 1`` permutes are
+    issued ahead of each block's ``pipeline_fn`` with ``depth`` revolving
+    receive buffers (capped at the step count, matching
+    ``analysis/schedverify.revolving_schedule``'s effective-depth
+    semantics). ``depth=2`` is the shipped double-buffered pipeline:
+    step t+1's ``ppermute`` is issued before block t's ``pipeline_fn``
+    is traced — op-for-op the pre-depth program, pinned by the plan
+    fingerprints. Every per-block op — slice, encode, taint, permute,
+    decode, pipeline — is IDENTICAL to the ``overlap=False`` schedule
+    at every depth, only the issue order changes, so the output is
+    bit-identical to RING while a scheduler that honors program order
+    (the TPU async start/done lowering) can keep ``depth - 1`` wire
+    transfers in flight under every block's compute instead of
+    alternating permute -> FFT -> permute.
+
+    ``subblocks`` adds the block-granularity axis (the Streams-chunks
+    idea applied INSIDE the ring): each travelling peer block is split
+    into ``ring_subblocks(concat_extent, subblocks)`` near-equal pieces
+    along ``concat_axis``, each riding its own ``ppermute`` micro-step,
+    so the first sub-block's ``pipeline_fn`` starts before the peer's
+    full payload has arrived. The wire codec and the fused hooks apply
+    per sub-block unchanged (both are elementwise / per-block by
+    contract), and ``concat_axis`` is always a safe split axis because
+    ``pipeline_fn`` must not mix data across it (see above) — so
+    sub-blocking composes with every family's pipelined FFT stage.
+    ``subblocks=1`` (default) traces the exact pre-split program.
 
     ``encode_fn``/``arrive_fn`` are the FUSED-WIRE hooks
     (``Config.fused_wire``; ``ops/pallas_fft`` fused-wire kernels):
@@ -343,19 +370,30 @@ def ring_transpose(x, axis_name: str, split_axis: int, concat_axis: int, *,
     obs.metrics.gauge("wire.bytes_per_transpose",
                       wire_nbytes(x.shape, x.dtype, wire))
     with obs.span("exchange.ring", axis=axis_name, wire=wire,
-                  overlap=bool(overlap)):
+                  overlap=bool(overlap), depth=int(depth),
+                  subblocks=int(subblocks)):
         return _ring_transpose_impl(x, axis_name, split_axis, concat_axis,
                                     pipeline_fn=pipeline_fn, wire=wire,
-                                    overlap=overlap, encode_fn=encode_fn,
+                                    overlap=overlap, depth=depth,
+                                    subblocks=subblocks, encode_fn=encode_fn,
                                     arrive_fn=arrive_fn)
 
 
 def _ring_transpose_impl(x, axis_name: str, split_axis: int,
                          concat_axis: int, *, pipeline_fn, wire: str,
-                         overlap: bool = False, encode_fn=None,
+                         overlap: bool = False, depth: int = 2,
+                         subblocks: int = 1, encode_fn=None,
                          arrive_fn=None):
     """``ring_transpose`` proper (split out so the obs span wraps one
     call site)."""
+    if depth < 1:
+        raise ValueError(f"overlap depth must be >= 1, got {depth}")
+    if overlap and depth < 2:
+        raise ValueError(
+            f"the revolving-buffer overlap schedule needs depth >= 2, "
+            f"got {depth} (use overlap=False for the serial ring)")
+    if subblocks < 1:
+        raise ValueError(f"subblocks must be >= 1, got {subblocks}")
     p = _axis_size(axis_name)
     wired = _wire_active(x, wire)
     if pipeline_fn is None:
@@ -371,18 +409,28 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
             f"mesh axis size {p} (plans pad before the exchange)")
     ch = ext // p
     r = lax.axis_index(axis_name)
+    # Sub-block split table along the CONCAT axis (safe by the
+    # pipeline_fn contract above; ``ring_subblocks`` is the same clamp
+    # the contract decls use). subblocks=1 -> a single full-block
+    # "sub-block" with zero extra slice ops, so the pre-split program
+    # is traced op-for-op.
+    subs = chunk_slices(x.shape[c], max(1, subblocks))
+    nsub = len(subs)
 
     def chunk(i):
         # Block destined for peer (r + i) mod p: a traced-offset slice, so
         # every device runs the same program on its own rotation.
         return lax.dynamic_slice_in_dim(x, ((r + i) % p) * ch, ch, axis=s)
 
-    def send(t):
-        """Encode + taint + permute of step t's travelling block — the
-        wire side of one ring step, shared by both schedules so the
-        per-block ops cannot diverge between them."""
+    def send(t, j=0):
+        """Encode + taint + permute of step t's travelling (sub-)block —
+        the wire side of one ring micro-step, shared by both schedules
+        so the per-block ops cannot diverge between them."""
         perm = [(src, (src + t) % p) for src in range(p)]
         b = chunk(t)
+        if nsub > 1:
+            off, sz = subs[j]
+            b = lax.slice_in_dim(b, off, off + sz, axis=c)
         if wired:
             if encode_fn is None:
                 b = wire_encode(b, wire)  # carries the wire/encode scope
@@ -396,12 +444,14 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
         return lax.ppermute(b, axis_name, perm)
 
     def arrive(b):
-        """Decode + per-block pipeline of one ARRIVED block (the receive
-        side of a ring step); ``arrive_fn`` fuses the pair. The fused
-        hook traces under the wire/decode scope (a family's arrive may
-        nest its pipelined-FFT stage scope inside — innermost wins in
-        attribution, so the fused DFT still lands on its local_fft
-        node)."""
+        """Decode + per-block pipeline of one ARRIVED (sub-)block (the
+        receive side of a ring micro-step); ``arrive_fn`` fuses the
+        pair. The fused hook traces under the wire/decode scope (a
+        family's arrive may nest its pipelined-FFT stage scope inside —
+        innermost wins in attribution, so the fused DFT still lands on
+        its local_fft node). Both apply per sub-block unchanged: the
+        codec is elementwise and pipeline_fn never mixes data across
+        the concat (= sub-block) axis."""
         if arrive_fn is not None:
             with obs.profile.wire_scope("decode"):
                 return arrive_fn(b)
@@ -410,28 +460,45 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
         return pipeline_fn(b)
 
     # Step 0 is the local block (peer r -> itself, no wire). Step t sends
-    # chunk r+t to peer r+t and receives peer (r-t)'s block for us.
-    if not overlap:
-        # RING: the received block is pipelined immediately, before step
-        # t+1's permute is issued.
-        blocks = [pipeline_fn(chunk(0))]
-        for t in range(1, p):
-            blocks.append(arrive(send(t)))
-    else:
-        # RING_OVERLAP: software pipeline with two revolving buffers.
-        # Step 1's permute is issued FIRST (its operand — chunk 1 —
-        # carries no dependency on any compute), the local block's FFTs
-        # trace under it, and inside the loop step t+1's permute is
-        # issued before block t's arrive-side compute, so each transfer
-        # can be in flight while the previous block computes. Same ops
-        # as RING in a reordered schedule — bit-identical output.
-        in_flight = send(1)
-        blocks = [pipeline_fn(chunk(0))]
-        for t in range(1, p):
-            current = in_flight
-            if t + 1 < p:
-                in_flight = send(t + 1)
-            blocks.append(arrive(current))
+    # chunk r+t to peer r+t and receives peer (r-t)'s block for us. With
+    # sub-blocks each peer step becomes ``nsub`` micro-steps, each
+    # riding its own ppermute.
+    steps = p - 1
+    micro = steps * nsub
+
+    def msend(m):
+        # Micro-step m (1-based) = sub-block (m-1) % nsub of peer step
+        # (m-1) // nsub + 1 — the same linearization
+        # ``schedverify.revolving_schedule`` proves hazard-free.
+        return send((m - 1) // nsub + 1, (m - 1) % nsub)
+
+    # Issue-ahead window: ``depth`` revolving receive buffers -> up to
+    # ``depth - 1`` permutes in flight ahead of the compute front (the
+    # effective buffer count is additionally capped at the micro-step
+    # count — schedverify's effective-depth semantics; a ring can never
+    # hold more outstanding transfers than it has steps). The serial
+    # RING is the zero-window degenerate of the same loop: issue
+    # micro-step m, then arrive it immediately. At depth=2 / nsub=1 the
+    # loop below traces op-for-op the shipped double-buffered
+    # RING_OVERLAP order (pre-issue step 1's permute — its operand
+    # carries no dependency on any compute — then inside the loop issue
+    # t+1's permute before arriving block t), pinned by the plan
+    # fingerprints; at every depth the per-block ops are those of the
+    # serial ring in a reordered schedule — bit-identical output.
+    w = min(depth - 1, micro) if overlap else 0
+    queue = [msend(m) for m in range(1, w + 1)]
+    blocks = [pipeline_fn(chunk(0))]
+    landed = []
+    for m in range(1, micro + 1):
+        nxt = m + w
+        if nxt <= micro:
+            queue.append(msend(nxt))
+        landed.append(arrive(queue.pop(0)))
+    # Re-join each peer step's sub-blocks along the concat axis (the
+    # axis they were split on; single sub-block passes through).
+    for t in range(1, p):
+        blocks.append(concat_axis_chunks(landed[(t - 1) * nsub:t * nsub],
+                                         c))
     # Reassemble in PEER order along the concat axis (tiled all_to_all
     # semantics: the block from peer j lands at concat slot j). Block t
     # came from peer (r - t) mod p, so peer order is the arrival order
@@ -447,37 +514,53 @@ def _ring_transpose_impl(x, axis_name: str, split_axis: int,
 
 
 def ring_schedule(payload_shape, dtype, wire: str, p: int,
-                  overlap: bool = False, depth: int = 2) -> dict:
+                  overlap: bool = False, depth: int = 2,
+                  subblocks: int = 1) -> dict:
     """Static description of a ring exchange's schedule over a GLOBAL
     padded payload of ``payload_shape`` (what ``dfft-explain`` prints for
-    a resolved RING/RING_OVERLAP plan): ``steps`` permutes per device,
-    ``buffers`` revolving receive buffers (``depth`` under the
-    revolving-buffer overlap schedule — the shipped double-buffered
-    pipeline is ``depth=2``; 1 for the plain ring), the per-device
-    travelling block's wire bytes (one P-th of the local shard — the
-    unit in flight on each step), the peak bytes in flight per device,
-    and the total wire bytes across the mesh (the ``(P-1)/P`` ring
-    discount: the local block never travels).
+    a resolved RING/RING_OVERLAP plan): ``steps`` peer steps per device
+    (``permutes`` = ``steps * subblocks`` micro-steps once the
+    block-granularity axis splits each peer block), ``buffers`` revolving
+    receive buffers, the per-device travelling block's wire bytes (one
+    P-th of the local shard) and the sub-block's (the unit in flight on
+    each micro-step), the peak bytes in flight per device, and the total
+    wire bytes across the mesh (the ``(P-1)/P`` ring discount: the local
+    block never travels).
 
-    ``depth`` > 2 describes the generalized D-way revolving pipeline
-    (ROADMAP item 3's autotune axis); ``analysis/schedverify.py``
-    statically proves the generated schedule hazard-free at any depth
-    before a plan may trace it."""
+    ``buffers`` reports the EFFECTIVE buffer count: the requested
+    ``depth`` capped at the micro-step count (``schedverify``'s
+    effective-depth semantics — depth 8 on 8 ranks holds 7 buffers, and
+    this descriptor says so; a descriptor claiming more buffers than the
+    ring has steps would overstate the in-flight bytes). ``depth`` > 2
+    describes the generalized D-way revolving pipeline (ROADMAP item 3's
+    autotune axis); ``analysis/schedverify.py`` statically proves the
+    generated schedule hazard-free at any depth/split before a plan may
+    trace it."""
     if depth < 1:
         raise ValueError(f"buffer depth must be >= 1, got {depth}")
+    if subblocks < 1:
+        raise ValueError(f"subblocks must be >= 1, got {subblocks}")
     total = wire_nbytes(payload_shape, dtype, wire)
     block = total // (p * p) if p > 1 else total
     steps = max(0, p - 1)
-    buffers = depth if overlap else 1
+    sub = max(1, subblocks)
+    micro = steps * sub
+    # Largest sub-block (chunk_slices spreads the remainder over the
+    # leading pieces) — the honest peak unit in flight.
+    sub_block = block if sub == 1 else -(-block // sub)
+    buffers = (min(depth, micro) if micro else 0) if overlap else 1
     return {
         "steps": steps,
+        "subblocks": sub,
+        "permutes": micro,
         "buffers": buffers,
+        "effective_depth": buffers if overlap else 1,
         "block_wire_bytes": block,
-        # One transfer in flight while the previous block computes: the
-        # overlap schedule holds ``depth`` block-sized buffers live per
-        # device (the in-flight and the computing blocks); the plain
+        "subblock_wire_bytes": sub_block,
+        # Up to ``buffers`` sub-block-sized transfers live per device
+        # (the in-flight window plus the computing block); the plain
         # ring holds one.
-        "bytes_in_flight": block * buffers,
+        "bytes_in_flight": sub_block * buffers,
         "total_wire_bytes": total * steps // p if p > 1 else 0,
     }
 
@@ -553,6 +636,78 @@ def all_to_all_transpose(x, axis_name: str, split_axis: int, concat_axis: int,
         return _all_to_all_native(inject.taint_wire(x, "all_to_all"),
                                   axis_name, split_axis, concat_axis,
                                   realigned)
+
+
+def pipelined_all_to_all(x, axis_name: str, split_axis: int,
+                         concat_axis: int, *, chunk_axis: int, chunks: int,
+                         depth: int = 2, realigned: bool = False,
+                         wire: str = WIRE_NATIVE):
+    """Software-pipelined rendering of the monolithic ``all_to_all``
+    exchange (``Config.overlap_subblocks`` > 1 under ``ALL2ALL`` +
+    SYNC/MPI_TYPE): the payload is split into ``chunks`` near-equal
+    pieces along ``chunk_axis`` — an axis the exchange does not touch —
+    and chunk k+1's collective is ISSUED before chunk k is decoded, with
+    an issue-ahead window of ``depth - 1`` collectives (the same
+    revolving window as the depth-D ring), so opt0/opt1 get
+    compute/communication overlap without switching to the ring
+    rendering.
+
+    Each chunk's exchange is the exact monolithic rendering (wire encode
+    -> taint -> tiled/realigned ``lax.all_to_all`` -> decode) applied to
+    a slice along an uninvolved axis, and slices along an uninvolved
+    axis commute with ``all_to_all`` — so the concatenated result is
+    BIT-IDENTICAL to ``all_to_all_transpose`` on the whole payload (the
+    wire codec is elementwise; pinned by tests). ``chunk_axis`` must
+    differ from ``split_axis``/``concat_axis``; ``chunks`` is clamped to
+    the chunk-axis extent (``chunk_slices`` semantics — the census decl
+    must use the same clamp).
+
+    CPU-mesh caveat (mirrors STREAMS' measured result): the CPU
+    backend's synchronous lowering runs the K collectives back-to-back,
+    so this rendering only reorders ops there; the async start/done
+    lowering on TPU is what turns the issue-ahead window into overlap.
+    Unlike the GSPMD piece-reshards, the K explicit ``all_to_all`` ops
+    carry different operands and are NOT re-fused into one collective
+    (the streams precedent: K instances survive in the HLO — the census
+    contract pins exactly ``chunks`` all-to-alls)."""
+    if chunk_axis in (split_axis, concat_axis):
+        raise ValueError(
+            f"pipelined all_to_all needs a chunk axis the exchange does "
+            f"not touch, got chunk_axis={chunk_axis} with "
+            f"split={split_axis}/concat={concat_axis}")
+    if depth < 1:
+        raise ValueError(f"overlap depth must be >= 1, got {depth}")
+    obs.metrics.inc("wire.exchanges_traced")
+    obs.metrics.gauge("wire.bytes_per_transpose",
+                      wire_nbytes(x.shape, x.dtype, wire))
+    with obs.span("exchange.a2a_pipe", axis=axis_name, chunks=int(chunks),
+                  depth=int(depth), realigned=bool(realigned), wire=wire):
+        wired = _wire_active(x, wire)
+
+        def issue(pc):
+            if wired:
+                y = wire_encode(pc, wire)
+                y = inject.taint_wire(y, "a2a_pipe")
+                return _all_to_all_native(y, axis_name, split_axis + 1,
+                                          concat_axis + 1, realigned)
+            return _all_to_all_native(inject.taint_wire(pc, "a2a_pipe"),
+                                      axis_name, split_axis, concat_axis,
+                                      realigned)
+
+        def land(y):
+            return wire_decode(y, x.dtype, wire) if wired else y
+
+        pieces = split_axis_chunks(x, chunk_axis, chunks)
+        k = len(pieces)
+        w = min(depth - 1, k - 1)
+        queue = [issue(pieces[i]) for i in range(w)]
+        out = []
+        for i in range(k):
+            nxt = i + w
+            if nxt < k:
+                queue.append(issue(pieces[nxt]))
+            out.append(land(queue.pop(0)))
+        return concat_axis_chunks(out, chunk_axis)
 
 
 def _all_to_all_native(x, axis_name: str, split_axis: int, concat_axis: int,

@@ -108,6 +108,29 @@ def parse_wire_dtype(s: str) -> str:
         f"unknown wire dtype: {s!r} (choose from {_WIRE_DTYPES})")
 
 
+# Overlap depths the comm autotuner races for the revolving-buffer ring
+# (and the issue-ahead window of the pipelined all_to_all): the
+# schedule-verified candidate set — ``analysis/schedverify.py`` proves
+# every member hazard-free per mesh size before a plan may trace it.
+OVERLAP_DEPTHS = (2, 4, 8)
+
+
+def parse_overlap_depth(s: "str | int") -> "str | int":
+    """Canonical ``Config.overlap_depth`` value: ``"auto"`` (wisdom /
+    race-resolved) or an int >= 2 (the revolving receive-buffer count;
+    capped at the ring's step count at trace time)."""
+    if isinstance(s, str) and s.strip().lower() == AUTO:
+        return AUTO
+    try:
+        v = int(s)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"overlap depth must be an int >= 2 or {AUTO!r}, got {s!r}")
+    if v < 2:
+        raise ValueError(f"overlap depth must be >= 2, got {v}")
+    return v
+
+
 def parse_comm_method(s: "str | CommMethod") -> "str | CommMethod":
     """``CommMethod.parse`` that additionally accepts ``"auto"`` (the
     wisdom-resolved marker, owning the whole comm x send x opt x chunk
@@ -405,6 +428,26 @@ class Config:
     chunk axis extent at trace time. More chunks = more overlap windows
     but smaller (less bandwidth-efficient) exchanges.
 
+    ``overlap_depth`` (``"auto"`` default) sets the revolving
+    receive-buffer depth of the overlap schedules: RING_OVERLAP's ring
+    issues up to ``depth - 1`` permutes ahead of the per-block FFTs
+    (``"auto"`` -> 2, the shipped double-buffered pipeline, traced
+    op-for-op as before), and the pipelined all_to_all uses the same
+    value as its issue-ahead window. Capped at the exchange's step
+    count (depth 8 on 8 ranks runs 7 buffers — and the descriptors say
+    so). ``autotune_comm`` races depths ``OVERLAP_DEPTHS`` as wisdom
+    candidates. ``overlap_subblocks`` (None -> 1) splits each
+    travelling block into S sub-blocks: on a ring each peer block
+    becomes S ppermute micro-steps (the first sub-block's FFT starts
+    before the peer's full payload has arrived — the Streams-chunks
+    idea inside the ring); under ALL2ALL + SYNC/MPI_TYPE a value > 1
+    software-pipelines the monolithic collective into S chunked
+    ``all_to_all``s (the ``a2a_pipe`` rendering), so opt0/opt1 get
+    overlap without switching to the ring. Every depth/sub-block
+    variant is bit-identical to its serial rendering;
+    ``analysis/schedverify.py`` proves each shipped schedule
+    hazard-free before a plan may trace it.
+
     ``wire_dtype`` selects the WIRE encoding of every global exchange
     (``parallel/transpose`` wire layer; CLI ``-wire``, env ``$DFFT_WIRE``):
     ``"native"`` keeps today's bit-identical payload; ``"bf16"`` packs the
@@ -491,6 +534,8 @@ class Config:
     mxu_direct_max: Optional[int] = None
     fft3d_chunk: Optional[int] = None
     streams_chunks: Optional[int] = None
+    overlap_depth: "int | str" = AUTO
+    overlap_subblocks: Optional[int] = None
     wire_dtype: str = "native"
     wire_error_budget: Optional[float] = None
     fused_wire: bool = False
@@ -538,6 +583,20 @@ class Config:
             raise ValueError(
                 f"streams_chunks must be a positive int or None, "
                 f"got {self.streams_chunks!r}")
+        # parse_overlap_depth canonicalizes (and rejects depths < 2) at
+        # Config construction, like guards below — a typo'd depth fails
+        # here, not at first trace.
+        object.__setattr__(self, "overlap_depth",
+                           parse_overlap_depth(self.overlap_depth))
+        if self.overlap_subblocks is not None and (
+                not isinstance(self.overlap_subblocks, int)
+                or self.overlap_subblocks < 1):
+            # >= 1, not >= 2: subblocks=1 degrades gracefully to the
+            # monolithic per-peer block (ring_subblocks clamps anyway),
+            # mirroring the streams_chunks contract above.
+            raise ValueError(
+                f"overlap_subblocks must be a positive int or None, "
+                f"got {self.overlap_subblocks!r}")
         if self.wire_dtype not in _WIRE_DTYPES:
             raise ValueError(
                 f"wire_dtype must be one of {_WIRE_DTYPES}, "
@@ -594,6 +653,27 @@ class Config:
     def resolved_streams_chunks(self) -> int:
         """Chunk count for the STREAMS pipelined transpose (None -> 4)."""
         return self.streams_chunks if self.streams_chunks is not None else 4
+
+    def resolved_overlap_depth(self) -> int:
+        """Revolving receive-buffer depth of the overlap schedules
+        (RING_OVERLAP's ring, the pipelined all_to_all's issue-ahead
+        window). ``"auto"`` -> 2, the shipped double-buffered pipeline —
+        so every pre-depth program (and its fingerprint pin) is traced
+        op-for-op unchanged unless a deeper schedule is explicitly
+        chosen or wisdom-resolved. Capped at the exchange's step count
+        at trace time (``ring_transpose``) and in every descriptor
+        (``ring_schedule`` / ``schedverify.describe``)."""
+        return 2 if self.overlap_depth == AUTO else int(self.overlap_depth)
+
+    def resolved_overlap_subblocks(self) -> int:
+        """Sub-blocks each travelling block is split into (None -> 1,
+        the monolithic per-peer block). On a ring rendering this is the
+        block-granularity axis (each peer block -> S ppermute
+        micro-steps); under ALL2ALL + SYNC/MPI_TYPE a value > 1 selects
+        the pipelined all_to_all rendering with S chunked collectives.
+        Clamped to the split axis extent at trace time."""
+        return (self.overlap_subblocks
+                if self.overlap_subblocks is not None else 1)
 
     def fused_wire_for(self, snd: "SendMethod") -> bool:
         """The fused-wire predicate for an exchange rendered by ``snd``:

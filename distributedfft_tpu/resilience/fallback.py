@@ -79,13 +79,19 @@ def next_rung(cfg) -> Tuple[Optional[object], Optional[str]]:
 
     from .. import params as pm
     sends = (cfg.send_method, cfg.send_method2)
-    if any(s not in (None, pm.SendMethod.SYNC, pm.SendMethod.MPI_TYPE)
-           for s in sends):
-        # The pipelined renderings demote to the realigned monolithic
-        # exchange (the ladder's "opt1" rung), not straight to default:
-        # opt1 is the better-performing safe rendering (README matrix).
+    if (any(s not in (None, pm.SendMethod.SYNC, pm.SendMethod.MPI_TYPE)
+            for s in sends)
+            or cfg.resolved_overlap_subblocks() > 1):
+        # The pipelined renderings — rings at any overlap depth, sub-
+        # block splits, AND the pipelined all-to-all (Sync + subblocks
+        # > 1) — demote to the realigned MONOLITHIC exchange (the
+        # ladder's "opt1" rung), not straight to default: opt1 is the
+        # better-performing safe rendering (README matrix). The overlap
+        # knobs reset too, or the "demoted" cell would still be the
+        # pipelined a2a.
         return dc.replace(cfg, send_method=pm.SendMethod.SYNC,
                           send_method2=None, streams_chunks=None,
+                          overlap_depth=pm.AUTO, overlap_subblocks=None,
                           opt=1), RUNG_SEND
     if cfg.opt == 1:
         return dc.replace(cfg, opt=0), RUNG_OPT

@@ -33,7 +33,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
-from ..params import FFTNorm
+from ..params import OVERLAP_DEPTHS, FFTNorm
 from ..resilience import inject
 from . import chaintimer
 
@@ -271,13 +271,22 @@ class CommCandidate:
     ``"bf16"`` candidates carry their measured forward error vs the
     native reference in ``wire_rel_err`` and are GATED on the error
     budget; ``wire=None`` keeps the base config's wire and is never
-    folded, so an un-raced axis cannot clobber an explicit choice)."""
+    folded, so an un-raced axis cannot clobber an explicit choice). The
+    overlap-schedule axes follow the same contract: ``depth`` is the
+    revolving-buffer ring depth (``Config.overlap_depth``) and
+    ``subblocks`` the per-peer sub-block split
+    (``Config.overlap_subblocks``) — a SYNC candidate with
+    ``subblocks>1`` races the pipelined all-to-all rendering
+    (``parallel/transpose.pipelined_all_to_all``); ``None`` keeps the
+    base config's knob and is never folded."""
     comm: object                 # CommMethod for transpose 1
     comm2: Optional[object]      # pencil transpose 2 (None for slab)
     opt: int
     send: object = None          # SendMethod.STREAMS/RING variants only
     chunks: Optional[int] = None  # streams_chunks for send=STREAMS
     wire: Optional[str] = None   # wire dtype; None = base config's (unraced)
+    depth: Optional[int] = None  # overlap_depth; None = base's (unraced)
+    subblocks: Optional[int] = None  # overlap_subblocks; None = base's
     fwd_ms: float = float("nan")
     inv_ms: float = float("nan")
     wire_rel_err: float = float("nan")  # bf16 only: fwd max rel err vs native
@@ -298,8 +307,15 @@ class CommCandidate:
             tag += "/ring"
         elif name == "RING_OVERLAP":
             tag += "/ring-ovl"
+            if self.depth not in (None, 2):
+                tag += f"-d{self.depth}"
         elif name == "STREAMS":
             tag += f"/streams{self.chunks}"
+        elif (name in ("SYNC", "MPI_TYPE")
+                and self.subblocks not in (None, 1)):
+            tag += "/a2a-pipe"
+        if self.subblocks not in (None, 1):
+            tag += f"/sub{self.subblocks}"
         if self.wire not in (None, "native"):
             tag += f"/{self.wire}"
         return tag
@@ -351,6 +367,11 @@ def _measure_comm_candidates(cands, kind, global_size, partition, base,
                     cfg = dc.replace(cfg, send_method=c.send,
                                      send_method2=None,
                                      streams_chunks=c.chunks)
+                if c.depth is not None:
+                    cfg = dc.replace(cfg, overlap_depth=int(c.depth))
+                if c.subblocks is not None:
+                    cfg = dc.replace(cfg,
+                                     overlap_subblocks=int(c.subblocks))
                 if c.wire is not None:
                     cfg = dc.replace(cfg, wire_dtype=c.wire)
 
@@ -445,6 +466,8 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
                   dims: int = 3, transform: str = "r2c",
                   race_send: bool = False,
                   streams_chunks: Sequence[int] = (4,),
+                  overlap_depths: Sequence[int] = OVERLAP_DEPTHS,
+                  overlap_splits: Sequence[int] = (1, 2),
                   race_wire: bool = False,
                   wire_error_budget: Optional[float] = None,
                   verbose: bool = False) -> List[CommCandidate]:
@@ -462,17 +485,23 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
 
     ``race_send=True`` adds the send-method axis: each ALL2ALL point also
     races the STREAMS chunked-pipelined transpose at every piece count in
-    ``streams_chunks`` (the reference's ``-snd`` dimension), plus ONE
+    ``streams_chunks`` (the reference's ``-snd`` dimension), a pipelined
+    all-to-all candidate per sub-block split in ``overlap_splits`` > 1
+    (the SYNC collective software-pipelined in
+    ``parallel/transpose.pipelined_all_to_all`` — it wraps the cell's own
+    ``lax.all_to_all``, so it IS raced per opt point), ONE
     ``SendMethod.RING`` candidate (the ppermute ring rendering,
-    ``parallel/transpose.ring_transpose``) and ONE ``RING_OVERLAP``
-    candidate (the double-buffered ring schedule — bit-identical output,
-    reordered issue; on a backend whose scheduler honors the reordering
-    it times differently, so it races as its own cell and the wisdom
-    store records whichever schedule won — store schema v4). The rings
-    own the exchange rendering regardless of comm_method and ignore the
-    opt layout axis (both are properties of the ``lax.all_to_all`` they
-    replace), so each races once — under the first opt's ALL2ALL point —
-    not per cell.
+    ``parallel/transpose.ring_transpose``) and one ``RING_OVERLAP``
+    candidate per ``overlap_depths`` x ``overlap_splits`` cell (the
+    revolving-buffer ring schedule — bit-identical output, reordered
+    issue; depth and sub-block split change how far the schedule runs
+    ahead of the arrivals, so each combination races as its own cell and
+    the wisdom store records whichever schedule won — store schema v5;
+    the depth-2/split-1 cell is the shipped double-buffered default and
+    keeps its legacy ``/ring-ovl`` label). The rings own the exchange
+    rendering regardless of comm_method and ignore the opt layout axis
+    (both are properties of the ``lax.all_to_all`` they replace), so each
+    races once — under the first opt's ALL2ALL point — not per cell.
     PEER2PEER points are not crossed — GSPMD re-fuses piece reshards into
     one collective (measured, ``models/slab._assemble_pure``), so a
     P2P+STREAMS candidate would mismeasure a program identical to SYNC.
@@ -511,6 +540,13 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
     both = (CommMethod.ALL2ALL, CommMethod.PEER2PEER)
     opts = (0, 1) if race_opt else (base.opt,)
     race_comm2 = kind == "pencil" and dims >= 3
+    # Normalized overlap axes: dedup, clamp to valid values, and keep the
+    # shipped default first so the depth-2/split-1 cell is the legacy
+    # candidate (depth/subblocks=None -> the base config's knobs).
+    depth_axis = tuple(dict.fromkeys(
+        int(d) for d in overlap_depths if int(d) >= 2)) or (2,)
+    split_axis = tuple(dict.fromkeys(
+        int(s) for s in overlap_splits if int(s) >= 1)) or (1,)
     cands: List[CommCandidate] = []
     for opt in opts:
         for c1 in both:
@@ -523,18 +559,31 @@ def autotune_comm(kind: str, global_size, partition, base_config=None,
                                             send=SendMethod.STREAMS,
                                             chunks=int(k))
                               for k in streams_chunks if k and int(k) > 1]
+                    # The pipelined all-to-all wraps THIS cell's
+                    # lax.all_to_all (opt changes the realignment it
+                    # fuses), so it races per opt point — unlike the
+                    # rings below.
+                    cands += [CommCandidate(cc1, cc2, opt,
+                                            send=SendMethod.SYNC,
+                                            subblocks=int(s))
+                              for s in split_axis if int(s) > 1]
                     if opt == opts[0]:
                         # The rings are opt- and comm-agnostic (they
                         # replace the all_to_all those knobs
                         # parameterize): one candidate each, not a
-                        # duplicate per matrix cell. RING_OVERLAP is a
-                        # distinct cell — same math, reordered schedule,
-                        # different time wherever the scheduler can
-                        # overlap.
+                        # duplicate per matrix cell. RING_OVERLAP cells
+                        # are distinct per depth x sub-block split —
+                        # same math, reordered schedule, different time
+                        # wherever the scheduler can overlap.
                         cands.append(CommCandidate(cc1, cc2, opt,
                                                    send=SendMethod.RING))
-                        cands.append(CommCandidate(
-                            cc1, cc2, opt, send=SendMethod.RING_OVERLAP))
+                        for d in depth_axis:
+                            for s in split_axis:
+                                cands.append(CommCandidate(
+                                    cc1, cc2, opt,
+                                    send=SendMethod.RING_OVERLAP,
+                                    depth=None if d == 2 else d,
+                                    subblocks=None if s == 1 else s))
     if race_wire:
         # Natives first (the twins' error reference), then the bf16 twin
         # of every cell. Explicit wire on both sides: the raced axis is
@@ -618,6 +667,12 @@ def apply_best_comm(candidates: List[CommCandidate], base_config=None):
         # --send-method the caller chose not to race).
         cfg = dc.replace(cfg, send_method=best.send, send_method2=None,
                          streams_chunks=best.chunks)
+    if best.depth is not None:
+        # Overlap axes fold exactly like the send/wire ones: only when
+        # raced, so an unraced candidate keeps the caller's knobs.
+        cfg = dc.replace(cfg, overlap_depth=int(best.depth))
+    if best.subblocks is not None:
+        cfg = dc.replace(cfg, overlap_subblocks=int(best.subblocks))
     if best.wire is not None:
         # Same contract for the wire axis: fold only when it was raced
         # (race_wire / autotune_wire set it explicitly on every

@@ -55,6 +55,25 @@ def _wire_suffix(config: Config) -> str:
     return "" if code == 0 else f"_w{code}"
 
 
+def _overlap_suffix(config: Config) -> str:
+    """Overlap-schedule filename tokens (the ``_w<code>`` precedent): the
+    shipped schedules — double-buffered depth 2, whole-block exchange —
+    keep the legacy filename byte-for-byte; a non-default revolving depth
+    appends ``_d<depth>`` (RingOverlap only: depth parameterizes no other
+    send method's program) and a sub-block split appends ``_s<k>``, so
+    variant runs can never interleave into one CSV as if they were
+    iterations of a single config."""
+    tag = ""
+    if config.send_method is SendMethod.RING_OVERLAP:
+        depth = config.resolved_overlap_depth()
+        if depth != 2:
+            tag += f"_d{depth}"
+    subs = config.resolved_overlap_subblocks()
+    if subs > 1:
+        tag += f"_s{subs}"
+    return tag
+
+
 def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
                        global_size: GlobalSize, pcnt: int,
                        pencil_grid=None) -> str:
@@ -67,7 +86,7 @@ def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
     comm = _COMM_CODE[config.comm_method]
     snd = _SEND_CODE[config.send_method]
     cuda = 1 if config.cuda_aware else 0
-    wire = _wire_suffix(config)
+    suffix = _overlap_suffix(config) + _wire_suffix(config)
     g = global_size
     d = os.path.join(benchmark_dir, variant)
     if pencil_grid is not None:
@@ -76,10 +95,10 @@ def benchmark_filename(benchmark_dir: str, variant: str, config: Config,
         p1, p2 = pencil_grid
         return os.path.join(
             d, f"test_{config.opt}_{comm}_{snd}_{comm2}_{snd2}"
-               f"_{g.nx}_{g.ny}_{g.nz}_{cuda}_{p1}_{p2}{wire}.csv")
+               f"_{g.nx}_{g.ny}_{g.nz}_{cuda}_{p1}_{p2}{suffix}.csv")
     return os.path.join(
         d, f"test_{config.opt}_{comm}_{snd}_{g.nx}_{g.ny}_{g.nz}_{cuda}"
-           f"_{pcnt}{wire}.csv")
+           f"_{pcnt}{suffix}.csv")
 
 
 class Timer:

@@ -21,7 +21,7 @@ zero measurement time.
 
 Store format: ONE JSON file::
 
-    {"version": 4,
+    {"version": 5,
      "entries": {"<canonical key json>": {"local_fft": {...}, "comm": {...},
                                           "wire": {...}}}}
 
@@ -36,12 +36,18 @@ Version 3 added the WIRE axis: ``comm`` records gained ``wire_dtype``
 error-budget-gated), and the ``wire`` slot records the wire-only race run
 for ``Config(wire_dtype="auto")`` with an explicit comm method.
 Version 4 added the RING_OVERLAP (double-buffered ring) rendering to the
-comm race (ISSUE 10). Legacy stores MIGRATE rather than error:
-``local_fft``/``wire`` (and any other non-``comm``) records are agnostic
-to the comm-race axes and carry over verbatim, while older ``comm``
-records were winners of races that never saw the ring (v1), wire (v1/v2)
-or overlap (v1-v3) axis and therefore read as misses (re-raced once,
-re-recorded under v4). Any later/unknown version reads as empty.
+comm race (ISSUE 10).
+Version 5 added the overlap-schedule axes (ISSUE 16): ``comm`` records
+carry ``overlap_depth``/``overlap_subblocks`` — the revolving-buffer ring
+depth and the per-peer sub-block split the race crossed into the ring and
+pipelined-all-to-all candidates (``None`` = the axis was not raced for
+that winner, same never-clobber contract as an unraced ``wire``). Legacy
+stores MIGRATE rather than error: ``local_fft``/``wire`` (and any other
+non-``comm``) records are agnostic to the comm-race axes and carry over
+verbatim, while older ``comm`` records were winners of races that never
+saw the ring (v1), wire (v1/v2), overlap (v1-v3) or depth/sub-block
+(v1-v4) axis and therefore read as misses (re-raced once, re-recorded
+under v5). Any later/unknown version reads as empty.
 
 Degradation contract: a missing, corrupt, partially-valid or
 version-mismatched store reads as EMPTY (re-measure); a record whose fields
@@ -113,10 +119,10 @@ except ImportError:
         def lock_contended() -> bool:
             return False
 
-WISDOM_VERSION = 4
+WISDOM_VERSION = 5
 # Store versions that migrate on load instead of reading empty (their
 # non-"comm" slots carry over; see _migrate_legacy).
-_LEGACY_VERSIONS = (1, 2, 3)
+_LEGACY_VERSIONS = (1, 2, 3, 4)
 ENV_VAR = "DFFT_WISDOM"
 # Wire dtypes a stored record may carry (the "auto" marker never lands on
 # disk — records hold measured winners).
@@ -314,13 +320,13 @@ class WisdomStore:
 
     @staticmethod
     def _migrate_legacy(raw: Dict[str, Any]) -> Dict[str, Any]:
-        """Legacy (v1-v3) store -> version-4 view: ``local_fft``/``wire``
+        """Legacy (v1-v4) store -> version-5 view: ``local_fft``/``wire``
         (and any other non-``comm``) records are agnostic to the
         comm-race axes and carry over; ``comm`` records predate an axis
         of the race (the RING rendering for v1, the wire dtype for v1/v2,
-        the RING_OVERLAP rendering for v1-v3) and are dropped, so they
-        re-measure as ordinary misses. Persisted as v4 by the next
-        ``record``."""
+        the RING_OVERLAP rendering for v1-v3, the overlap depth/sub-block
+        axes for v1-v4) and are dropped, so they re-measure as ordinary
+        misses. Persisted as v5 by the next ``record``."""
         entries = {}
         for k, e in raw["entries"].items():
             if not isinstance(e, dict):
@@ -333,7 +339,7 @@ class WisdomStore:
     def load(self) -> Dict[str, Any]:
         """Parsed store; ANY defect (missing file, malformed JSON, wrong
         schema, unknown version) degrades to the empty store. A legacy
-        (v1-v3) store migrates (see ``_migrate_legacy``) instead of
+        (v1-v4) store migrates (see ``_migrate_legacy``) instead of
         reading empty."""
         with obs.span("wisdom.load", path=self.path):
             try:
@@ -525,6 +531,15 @@ def comm_record(candidate: Any, base_config: Any = None) -> Dict[str, Any]:
         if isinstance(sm, pm.SendMethod) and sm is not pm.SendMethod.SYNC:
             rec["send_method"] = sm.value
             rec["streams_chunks"] = base_config.streams_chunks
+    # Overlap-schedule axes (store schema v5): the raced revolving-buffer
+    # depth and per-peer sub-block split, or None when the axis was not
+    # raced for this candidate — the fold then keeps the caller's knobs,
+    # so an unraced axis cannot clobber an explicit choice (same contract
+    # as ``wire``).
+    depth = getattr(candidate, "depth", None)
+    subs = getattr(candidate, "subblocks", None)
+    rec["overlap_depth"] = None if depth is None else int(depth)
+    rec["overlap_subblocks"] = None if subs is None else int(subs)
     # Wire axis (store schema v3): the raced wire, or the base config's
     # when the axis was not raced (wire=None candidates were timed with
     # the base's wire — the recorded program must be the measured one).
@@ -688,6 +703,18 @@ def _fold_comm_rec(cfg: Any, rec: Dict[str, Any]) -> Any:
             raise ValueError(f"stale streams_chunks {chunks!r}")
         cfg = dc.replace(cfg, send_method=pm.SendMethod.parse(
             rec["send_method"]), send_method2=None, streams_chunks=chunks)
+    # Overlap-schedule axes (v5 records): fold only when the axis was
+    # raced; a record carrying None keeps the base knobs.
+    depth = rec.get("overlap_depth")
+    if depth is not None:
+        if not isinstance(depth, int) or depth < 2:
+            raise ValueError(f"stale overlap_depth {depth!r}")
+        cfg = dc.replace(cfg, overlap_depth=depth)
+    subs = rec.get("overlap_subblocks")
+    if subs is not None:
+        if not isinstance(subs, int) or subs < 1:
+            raise ValueError(f"stale overlap_subblocks {subs!r}")
+        cfg = dc.replace(cfg, overlap_subblocks=subs)
     # v3 records always carry the wire axis; a hand-edited record missing
     # it folds as native (the conservative, bit-identical wire).
     wire = rec.get("wire_dtype", "native")
@@ -812,10 +839,14 @@ def _describe_comm(cfg: Any) -> str:
     tag += f"/opt{cfg.opt}"
     if cfg.send_method is pm.SendMethod.RING_OVERLAP:
         tag += "/ring-ovl"
+        if cfg.resolved_overlap_depth() != 2:
+            tag += f"-d{cfg.resolved_overlap_depth()}"
     elif cfg.send_method is pm.SendMethod.RING:
         tag += "/ring"
     elif cfg.send_method is pm.SendMethod.STREAMS:
         tag += f"/streams{cfg.resolved_streams_chunks()}"
+    if cfg.resolved_overlap_subblocks() > 1:
+        tag += f"/sub{cfg.resolved_overlap_subblocks()}"
     if cfg.wire_dtype != "native":
         tag += f"/{cfg.wire_dtype}"
     return tag
@@ -984,7 +1015,7 @@ def _broadcast_comm_hit(folded: Any, base: Any) -> Any:
     comms = (pm.CommMethod.ALL2ALL, pm.CommMethod.PEER2PEER)
     sends = _send_encoding()
     if folded is None:
-        vec = np.full(7, -1, dtype=np.int64)
+        vec = np.full(9, -1, dtype=np.int64)
     else:
         vec = np.asarray([
             1,
@@ -996,6 +1027,10 @@ def _broadcast_comm_hit(folded: Any, base: Any) -> Any:
             (-1 if folded.streams_chunks is None
              else int(folded.streams_chunks)),
             _WIRE_CONCRETE.index(folded.wire_dtype),
+            (-1 if folded.overlap_depth == pm.AUTO
+             else int(folded.overlap_depth)),
+            (-1 if folded.overlap_subblocks is None
+             else int(folded.overlap_subblocks)),
         ], dtype=np.int64)
     with obs.span("wisdom.broadcast", what="comm_hit"):
         vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
@@ -1009,7 +1044,9 @@ def _broadcast_comm_hit(folded: Any, base: Any) -> Any:
         opt=int(vec[3]),
         send_method=sends[int(vec[4])], send_method2=None,
         streams_chunks=None if vec[5] < 0 else int(vec[5]),
-        wire_dtype=_WIRE_CONCRETE[int(vec[6])])
+        wire_dtype=_WIRE_CONCRETE[int(vec[6])],
+        overlap_depth=pm.AUTO if vec[7] < 0 else int(vec[7]),
+        overlap_subblocks=None if vec[8] < 0 else int(vec[8]))
 
 
 def _resolve_comm(cfg: Any, store: Any, key: str, kind: str,
@@ -1029,11 +1066,14 @@ def _resolve_comm(cfg: Any, store: Any, key: str, kind: str,
     # never onto an explicit send_method the race did not measure. A
     # wire_dtype="auto" riding along normalizes to native here and is
     # raced as the wire axis of the same comm race (race_wire), so one
-    # race — and one stored record — owns both choices.
+    # race — and one stored record — owns both choices. The overlap
+    # depth/sub-block knobs normalize to defaults the same way (v5: the
+    # race owns those axes too — depth and split variants are candidates).
     race_wire = cfg.wire_dtype == pm.AUTO
     norm_base = dc.replace(_comm_defaults(cfg),
                            send_method=pm.SendMethod.SYNC,
-                           send_method2=None, streams_chunks=None)
+                           send_method2=None, streams_chunks=None,
+                           overlap_depth=pm.AUTO, overlap_subblocks=None)
     rec = store.lookup(key, "comm") if store else None
     folded, reason = _comm_hit_fold(norm_base, rec, race_wire,
                                     cfg.resolved_wire_budget())
@@ -1164,6 +1204,9 @@ def _agree_across_processes(cfg: Any) -> Any:
         sends.index(cfg.send_method),
         -1 if cfg.streams_chunks is None else int(cfg.streams_chunks),
         _WIRE_CONCRETE.index(cfg.wire_dtype),
+        -1 if cfg.overlap_depth == pm.AUTO else int(cfg.overlap_depth),
+        (-1 if cfg.overlap_subblocks is None
+         else int(cfg.overlap_subblocks)),
     ], dtype=np.int64)
     with obs.span("wisdom.broadcast", what="resolved_config"):
         vec = np.asarray(multihost_utils.broadcast_one_to_all(vec))
@@ -1177,7 +1220,9 @@ def _agree_across_processes(cfg: Any) -> Any:
         opt=int(vec[5]),
         send_method=sends[int(vec[6])],
         streams_chunks=None if vec[7] < 0 else int(vec[7]),
-        wire_dtype=_WIRE_CONCRETE[int(vec[8])])
+        wire_dtype=_WIRE_CONCRETE[int(vec[8])],
+        overlap_depth=pm.AUTO if vec[9] < 0 else int(vec[9]),
+        overlap_subblocks=None if vec[10] < 0 else int(vec[10]))
 
 
 def resolve_config(kind: str, global_size: Any, partition: Any,

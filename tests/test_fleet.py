@@ -529,8 +529,11 @@ def test_fleet_tenant_quota_and_p99_isolation():
 def test_fleet_live_scale_up_joins_ring():
     # Generous beat window: worker-2's spawn (a jax-importing process)
     # spikes both cores while worker-1 serves the backlog — a tight
-    # window would declare the starved-but-healthy worker-1 dead.
-    with _stub_fleet(1, stub_service_ms=20.0, worker_inflight=2,
+    # window would declare the starved-but-healthy worker-1 dead. The
+    # service time must keep the 14-request backlog alive across
+    # several (throttled) monitor ticks, or a fast idle host drains
+    # the queue before the depth gauge ever observes it.
+    with _stub_fleet(1, stub_service_ms=200.0, worker_inflight=2,
                      worker_pending=16, heartbeat_interval_s=0.25,
                      heartbeat_k=12) as f:
         ctl = ScaleController(f, 1, 2, cooldown_s=0.0, queue_high=2.0)

@@ -191,7 +191,7 @@ def test_migration_counted_and_noticed_once(tmp_path, capsys):
     store.load()
     store.load()  # second load of the same legacy store: no double count
     assert obs.metrics.counter_value("wisdom.migrations") == 1
-    assert "migrated(v1→v4)" in capsys.readouterr().out
+    assert "migrated(v1→v5)" in capsys.readouterr().out
 
 
 def test_hlo_census_feeds_gauges():

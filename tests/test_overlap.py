@@ -395,7 +395,7 @@ def test_v3_store_migrates_comm_rereaces(tmp_path):
     }}}))
     store = wisdom.WisdomStore(str(path))
     data = store.load()
-    assert data["version"] == wisdom.WISDOM_VERSION == 4
+    assert data["version"] == wisdom.WISDOM_VERSION == 5
     assert store.lookup(key, "comm") is None
     assert store.lookup(key, "local_fft")["fft_backend"] == "xla"
     assert store.lookup(key, "wire")["wire_dtype"] == "native"

@@ -1,0 +1,425 @@
+"""Autotuned overlap (ISSUE 16): variable-depth revolving-buffer rings,
+sub-block splits, and the software-pipelined all-to-all.
+
+Gates, per the issue's satellites and acceptance criteria:
+
+* (a) bit-identity: every depth x sub-block ring variant is bit-identical
+  to the serial RING rendering across the plan families and the bf16
+  wire, and depth=2/split-1 compiles to the SAME stripped op graph as
+  the shipped RING_OVERLAP (fingerprint pin — the new knobs changed no
+  shipped program);
+* (b) the pipelined all-to-all is bit-identical to the monolithic
+  exchange on uneven extents across all three families, covers the c2c
+  inverse, differentiates under ``jit(grad)``, and stages exactly
+  ``subblocks`` all-to-alls in the compiled HLO;
+* (c) schedule descriptors: ``ring_schedule`` reports the effective
+  depth under the (P-1)*S micro-step cap and the bytes-in-flight for the
+  chosen split; ``schedverify`` sweeps depths x splits and catches a
+  hazard planted in a sub-block schedule;
+* (d) wisdom v4 -> v5: local_fft/wire records carry over, pre-depth comm
+  records read as misses and re-race, and a demotion stamp on an
+  overlapped cell still demotes to the SYNC@opt1 rung (the ladder resets
+  the overlap knobs — "demoted" must not mean "still pipelined");
+* (e) autotune: ``autotune_comm`` races depth x sub-block cells plus the
+  pipelined a2a, keeps the legacy single-RING pin, and the winner
+  round-trips through the v5 store;
+* (f) Timer CSV / evalkit: shipped schedules keep their legacy filenames
+  byte-for-byte, the ``_d<depth>``/``_s<k>`` tokens follow the
+  ``_w<code>`` precedent, and eval reduces each variant as its own row.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedfft_tpu as dfft
+from distributedfft_tpu import params as pm
+from distributedfft_tpu.analysis import hloscan, schedverify
+from distributedfft_tpu.models.batched2d import Batched2DFFTPlan
+from distributedfft_tpu.parallel.transpose import ring_schedule
+from distributedfft_tpu.utils import wisdom
+
+# Uneven x extent: every decomposed-axis padding path stays covered.
+G = dfft.GlobalSize(20, 16, 16)
+OVL = pm.SendMethod.RING_OVERLAP
+
+
+def _cfg(send=None, wire="native", **kw):
+    kw.setdefault("use_wisdom", False)
+    if send is not None:
+        kw["send_method"] = send
+    return dfft.Config(wire_dtype=wire, **kw)
+
+
+def _pipe_cfg(opt=1, subblocks=2, wire="native", **kw):
+    return _cfg(None, wire, comm_method=pm.CommMethod.ALL2ALL, opt=opt,
+                overlap_subblocks=subblocks, **kw)
+
+
+# ---------------------------------------------------------------------------
+# (a) depth x sub-block rings: bit-identity + the depth-2 fingerprint pin
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth,sub,wire", [
+    (4, 1, "native"), (8, 1, "native"), (2, 2, "native"), (4, 2, "native"),
+    (8, 2, "bf16"),
+])
+def test_slab_depth_subblock_bit_identical_to_ring(devices, rng, depth,
+                                                   sub, wire):
+    ring = dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                            _cfg(pm.SendMethod.RING, wire))
+    ovl = dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                           _cfg(OVL, wire, overlap_depth=depth,
+                                overlap_subblocks=sub))
+    x = rng.random(G.shape).astype(np.float32)
+    a, b = np.asarray(ring.exec_r2c(x)), np.asarray(ovl.exec_r2c(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(ring.exec_c2r(a)),
+                          np.asarray(ovl.exec_c2r(b)))
+
+
+def test_pencil_depth_subblock_bit_identical_to_ring(devices, rng):
+    part = pm.PencilPartition(2, 4)
+    ring = dfft.PencilFFTPlan(G, part, _cfg(pm.SendMethod.RING))
+    ovl = dfft.PencilFFTPlan(G, part, _cfg(OVL, overlap_depth=4,
+                                           overlap_subblocks=2))
+    x = rng.random(G.shape).astype(np.float32)
+    a, b = np.asarray(ring.exec_r2c(x)), np.asarray(ovl.exec_r2c(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(ring.exec_c2r(a)),
+                          np.asarray(ovl.exec_c2r(b)))
+
+
+def test_batched2d_depth_subblock_bit_identical_to_ring(devices, rng):
+    ring = Batched2DFFTPlan(8, 20, 16, pm.SlabPartition(8),
+                            _cfg(pm.SendMethod.RING), shard="x")
+    ovl = Batched2DFFTPlan(8, 20, 16, pm.SlabPartition(8),
+                           _cfg(OVL, overlap_depth=8, overlap_subblocks=2),
+                           shard="x")
+    x = rng.random((8, 20, 16)).astype(np.float32)
+    a, b = np.asarray(ring.exec_forward(x)), np.asarray(ovl.exec_forward(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(ring.exec_inverse(a)),
+                          np.asarray(ovl.exec_inverse(b)))
+
+
+def test_depth2_split1_fingerprint_matches_shipped_overlap(devices):
+    """The acceptance pin: an explicit depth=2/split-1 config compiles to
+    the same stripped op graph as the pre-knob RING_OVERLAP default —
+    the new axes are strictly additive."""
+    base = dfft.SlabFFTPlan(G, pm.SlabPartition(8), _cfg(OVL))
+    explicit = dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                                _cfg(OVL, overlap_depth=2,
+                                     overlap_subblocks=1))
+    for d in ("forward", "inverse"):
+        assert (hloscan.plan_fingerprint(base, d, 3)
+                == hloscan.plan_fingerprint(explicit, d, 3))
+
+
+# ---------------------------------------------------------------------------
+# (b) pipelined all-to-all: bit-identity, c2c, grad, census
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt", [0, 1])
+def test_slab_a2a_pipe_bit_identical_to_monolithic(devices, rng, opt):
+    mono = dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                            _cfg(None, comm_method=pm.CommMethod.ALL2ALL,
+                                 opt=opt))
+    pipe = dfft.SlabFFTPlan(G, pm.SlabPartition(8), _pipe_cfg(opt=opt))
+    x = rng.random(G.shape).astype(np.float32)
+    a, b = np.asarray(mono.exec_r2c(x)), np.asarray(pipe.exec_r2c(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(mono.exec_c2r(a)),
+                          np.asarray(pipe.exec_c2r(b)))
+
+
+def test_pencil_a2a_pipe_bit_identical_to_monolithic(devices, rng):
+    part = pm.PencilPartition(2, 4)
+    mono = dfft.PencilFFTPlan(G, part,
+                              _cfg(None, comm_method=pm.CommMethod.ALL2ALL,
+                                   opt=1))
+    pipe = dfft.PencilFFTPlan(G, part, _pipe_cfg())
+    x = rng.random(G.shape).astype(np.float32)
+    a, b = np.asarray(mono.exec_r2c(x)), np.asarray(pipe.exec_r2c(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(mono.exec_c2r(a)),
+                          np.asarray(pipe.exec_c2r(b)))
+
+
+def test_batched2d_a2a_pipe_bit_identical_to_monolithic(devices, rng):
+    mono = Batched2DFFTPlan(8, 20, 16, pm.SlabPartition(8),
+                            _cfg(None, comm_method=pm.CommMethod.ALL2ALL,
+                                 opt=1), shard="x")
+    pipe = Batched2DFFTPlan(8, 20, 16, pm.SlabPartition(8), _pipe_cfg(),
+                            shard="x")
+    x = rng.random((8, 20, 16)).astype(np.float32)
+    a, b = np.asarray(mono.exec_forward(x)), np.asarray(pipe.exec_forward(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(mono.exec_inverse(a)),
+                          np.asarray(pipe.exec_inverse(b)))
+
+
+def test_a2a_pipe_c2c_inverse_matches_monolithic(devices, rng):
+    mono = dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                            _cfg(None, comm_method=pm.CommMethod.ALL2ALL,
+                                 opt=1), transform="c2c")
+    pipe = dfft.SlabFFTPlan(G, pm.SlabPartition(8), _pipe_cfg(),
+                            transform="c2c")
+    x = (rng.random(G.shape) + 1j * rng.random(G.shape)).astype(np.complex64)
+    a, b = np.asarray(mono.exec_c2c(x)), np.asarray(pipe.exec_c2c(x))
+    assert np.array_equal(a, b)
+    assert np.array_equal(np.asarray(mono.exec_c2c_inv(a)),
+                          np.asarray(pipe.exec_c2c_inv(b)))
+
+
+def test_grad_through_a2a_pipe_roundtrip(devices, rng):
+    g = dfft.GlobalSize(16, 16, 16)
+    plan = dfft.SlabFFTPlan(g, pm.SlabPartition(8), _pipe_cfg(),
+                            sequence="Z_Then_YX")
+    fwd, inv = plan.forward_fn(), plan.inverse_fn()
+    w = rng.random(g.shape)
+
+    def loss(x):
+        return jnp.sum(jnp.asarray(w) * inv(fwd(x)) / g.n_total)
+
+    got = np.asarray(jax.jit(jax.grad(loss))(rng.random(g.shape)))
+    np.testing.assert_allclose(got, w, atol=5e-2)
+
+
+def test_hlo_a2a_pipe_census_one_collective_per_chunk(devices):
+    """The pipelined rendering stages exactly ``subblocks`` all-to-alls
+    (GSPMD re-fusing them back into one would be caught right here, and
+    by the dfft-verify contract pin)."""
+    plan = dfft.SlabFFTPlan(G, pm.SlabPartition(8),
+                            _pipe_cfg(subblocks=2))
+    txt = hloscan.compiled_text(plan, "forward", 3)
+    census = hloscan.collective_census(txt)
+    assert census.get("all_to_all", 0) == 2, census
+
+
+# ---------------------------------------------------------------------------
+# (c) schedule descriptors + the hazard checker's sub-block coverage
+# ---------------------------------------------------------------------------
+
+def test_ring_schedule_effective_depth_cap_and_split():
+    """Depth 8 on 8 ranks holds 7 buffers and SAYS so; a sub-block split
+    multiplies the micro-steps and re-admits the 8th buffer; the
+    bytes-in-flight accounting follows the chosen split."""
+    sch = ring_schedule((64, 64, 33), np.complex64, "native", 8,
+                        overlap=True, depth=8)
+    assert sch["buffers"] == 7 and sch["effective_depth"] == 7
+    split = ring_schedule((64, 64, 33), np.complex64, "native", 8,
+                          overlap=True, depth=8, subblocks=2)
+    assert split["subblocks"] == 2
+    assert split["permutes"] == 14
+    assert split["buffers"] == 8 and split["effective_depth"] == 8
+    assert split["subblock_wire_bytes"] == -(-sch["block_wire_bytes"] // 2)
+    assert (split["bytes_in_flight"]
+            == split["subblock_wire_bytes"] * split["buffers"])
+    # The split moves the same total bytes — it changes granularity only.
+    assert split["total_wire_bytes"] == sch["total_wire_bytes"]
+
+
+def test_verify_shipped_depths_sweeps_subblock_splits():
+    rows = schedverify.verify_shipped_depths(8)
+    combos = {(r["depth"], r["subblocks"]) for r in rows if r["p"] == 8}
+    assert {(2, 1), (2, 2), (4, 1), (4, 2), (8, 1), (8, 2)} <= combos
+    assert all(r["ok"] for r in rows), rows
+
+
+def test_mutated_subblock_schedule_caught():
+    bad = schedverify.mutated_schedule("write-after-send", p=8, depth=2,
+                                       subblocks=2)
+    hazards = schedverify.check_schedule(bad, 8, 2, subblocks=2)
+    assert hazards and any("write-after-send" in str(h) for h in hazards)
+
+
+# ---------------------------------------------------------------------------
+# (d) wisdom v4 -> v5 migration + demotion on an overlapped cell
+# ---------------------------------------------------------------------------
+
+def _v4_store(tmp_path):
+    key = wisdom.plan_key("slab", (16, 16, 16), False, pm.SlabPartition(8),
+                          pm.FFTNorm.NONE)
+    path = tmp_path / "w4.json"
+    path.write_text(json.dumps({"version": 4, "entries": {key: {
+        "local_fft": {"fft_backend": "xla", "mxu_precision": None,
+                      "mxu_direct_max": None},
+        "wire": {"wire_dtype": "native"},
+        "comm": {"comm_method": "All2All", "comm_method2": None, "opt": 1,
+                 "send_method": "RingOverlap", "streams_chunks": None,
+                 "wire_dtype": "native", "wire_raced": True},
+    }}}))
+    return wisdom.WisdomStore(str(path)), key
+
+
+def test_v4_store_migrates_comm_rereaces(tmp_path):
+    """A v4 comm record predates the overlap depth/sub-block axes and
+    reads as a miss (re-race); local_fft and wire records carry over
+    verbatim, and the next record persists version 5."""
+    store, key = _v4_store(tmp_path)
+    data = store.load()
+    assert data["version"] == wisdom.WISDOM_VERSION == 5
+    assert store.lookup(key, "comm") is None
+    assert store.lookup(key, "local_fft")["fft_backend"] == "xla"
+    assert store.lookup(key, "wire")["wire_dtype"] == "native"
+    rec = {"comm_method": "All2All", "comm_method2": None, "opt": 0,
+           "send_method": "RingOverlap", "streams_chunks": None,
+           "wire_dtype": "native", "wire_raced": True,
+           "overlap_depth": 8, "overlap_subblocks": 2}
+    assert store.record(key, "comm", rec)
+    raw = json.loads(open(store.path).read())
+    assert raw["version"] == 5
+    folded = wisdom._fold_comm_rec(dfft.Config(), store.lookup(key, "comm"))
+    assert folded.send_method is OVL
+    assert folded.overlap_depth == 8 and folded.overlap_subblocks == 2
+
+
+def test_v5_comm_record_round_trips_overlap_axes(tmp_path):
+    """An overlapped autotune winner records its depth/sub-block axes
+    and folds them back; unraced axes (None) never clobber the base."""
+    from distributedfft_tpu.testing.autotune import CommCandidate
+    cand = CommCandidate(pm.CommMethod.ALL2ALL, None, 0, send=OVL,
+                         depth=8, subblocks=2, ok=True)
+    rec = wisdom.comm_record(cand, dfft.Config())
+    assert rec["overlap_depth"] == 8 and rec["overlap_subblocks"] == 2
+    folded = wisdom._fold_comm_rec(dfft.Config(), rec)
+    assert folded.overlap_depth == 8 and folded.overlap_subblocks == 2
+    legacy = CommCandidate(pm.CommMethod.ALL2ALL, None, 0, send=OVL,
+                           ok=True)
+    rec = wisdom.comm_record(legacy, dfft.Config())
+    assert rec["overlap_depth"] is None
+    base = dfft.Config(overlap_depth=4, overlap_subblocks=2)
+    folded = wisdom._fold_comm_rec(base, rec)
+    assert folded.overlap_depth == 4 and folded.overlap_subblocks == 2
+
+
+def test_stale_overlap_axes_read_as_miss():
+    for bad in ({"overlap_depth": 1}, {"overlap_subblocks": 0},
+                {"overlap_depth": "four"}):
+        rec = {"comm_method": "All2All", "comm_method2": None, "opt": 0,
+               "send_method": None, "streams_chunks": None,
+               "wire_dtype": "native", **bad}
+        with pytest.raises(ValueError):
+            wisdom._fold_comm_rec(dfft.Config(), rec)
+
+
+def test_demotion_stamp_on_overlapped_cell(tmp_path):
+    """A demotion stamp on an overlapped winner reads as a miss at fold
+    time, and the ladder demotes the overlapped config to the MONOLITHIC
+    SYNC@opt1 rung — overlap knobs reset, or the 'demoted' cell would
+    still be a pipelined rendering."""
+    from distributedfft_tpu.resilience import fallback
+    store, key = _v4_store(tmp_path)
+    rec = {"comm_method": "All2All", "comm_method2": None, "opt": 0,
+           "send_method": "RingOverlap", "streams_chunks": None,
+           "wire_dtype": "native", "wire_raced": True,
+           "overlap_depth": 8, "overlap_subblocks": 2}
+    assert store.record(key, "comm", rec)
+    assert wisdom.stamp_demotion(store, key, "comm", "send", "test failure")
+    stamped = store.lookup(key, "comm")
+    assert stamped["demoted"] and stamped["demoted_rung"] == "send"
+    folded, reason = wisdom._comm_hit_fold(dfft.Config(), stamped,
+                                           False, 1e-3)
+    assert folded is None and "demoted" in reason
+    # The live-plan ladder on the same overlapped cell: one rung, to the
+    # monolithic realigned exchange.
+    cfg = _cfg(OVL, overlap_depth=8, overlap_subblocks=2)
+    demoted, rung = fallback.next_rung(cfg)
+    assert rung == "send"
+    assert demoted.send_method is pm.SendMethod.SYNC and demoted.opt == 1
+    assert demoted.overlap_depth == pm.AUTO
+    assert demoted.overlap_subblocks is None
+
+
+def test_a2a_pipe_demotes_to_monolithic_sync_opt1():
+    """The pipelined all-to-all (Sync + subblocks>1) is a pipelined
+    rendering: its first rung is the monolithic SYNC@opt1, not a still-
+    chunked opt flip."""
+    from distributedfft_tpu.resilience import fallback
+    demoted, rung = fallback.next_rung(_pipe_cfg(opt=1))
+    assert rung == "send"
+    assert demoted.send_method is pm.SendMethod.SYNC and demoted.opt == 1
+    assert demoted.resolved_overlap_subblocks() == 1
+
+
+# ---------------------------------------------------------------------------
+# (e) autotune: the depth x sub-block race matrix
+# ---------------------------------------------------------------------------
+
+def test_autotune_comm_races_depth_by_subblock(devices):
+    from distributedfft_tpu.testing import autotune as at
+    ranked = at.autotune_comm("slab", dfft.GlobalSize(16, 16, 16),
+                              pm.SlabPartition(8),
+                              dfft.Config(use_wisdom=False),
+                              iterations=1, warmup=0, race_opt=False,
+                              race_send=True, streams_chunks=(),
+                              overlap_depths=(2, 4), overlap_splits=(1, 2))
+    labels = [c.label for c in ranked]
+    # The legacy pins: exactly one serial RING candidate, and the
+    # depth-2/split-1 overlap cell keeps its legacy "/ring-ovl" label.
+    assert sum(1 for c in ranked if c.send is pm.SendMethod.RING) == 1
+    assert any(lb.endswith("/ring-ovl") for lb in labels), labels
+    # The new cells: depth-4 rings, sub-block splits, the pipelined a2a.
+    assert any("/ring-ovl-d4" in lb and "/sub2" not in lb
+               for lb in labels), labels
+    assert any("/ring-ovl/sub2" in lb for lb in labels), labels
+    assert any("/ring-ovl-d4/sub2" in lb for lb in labels), labels
+    assert any("/a2a-pipe/sub2" in lb for lb in labels), labels
+    # Winner round-trip through the v5 schema.
+    ovl = next(c for c in ranked if c.depth == 4 and c.subblocks == 2)
+    assert ovl.ok, ovl.error
+    rec = wisdom.comm_record(ovl, dfft.Config())
+    assert rec["overlap_depth"] == 4 and rec["overlap_subblocks"] == 2
+    cfg = at.apply_best_comm([ovl], dfft.Config())
+    assert cfg.overlap_depth == 4 and cfg.overlap_subblocks == 2
+
+
+# ---------------------------------------------------------------------------
+# (f) Timer CSV filenames + evalkit reduction rows
+# ---------------------------------------------------------------------------
+
+def test_benchmark_filename_overlap_suffixes(tmp_path):
+    from distributedfft_tpu.utils.timer import benchmark_filename
+    g = dfft.GlobalSize(256, 256, 129)
+
+    def name(cfg):
+        import os
+        return os.path.basename(
+            benchmark_filename(str(tmp_path), "slab_default", cfg, g, 8))
+
+    # Shipped schedules: legacy filenames byte-for-byte.
+    assert name(_cfg(None)) == "test_0_1_0_256_256_129_1_8.csv"
+    assert name(_cfg(OVL)) == "test_0_1_4_256_256_129_1_8.csv"
+    assert (name(_cfg(OVL, overlap_depth=2))
+            == "test_0_1_4_256_256_129_1_8.csv")
+    # New variants: _d then _s, before _w, following the _w precedent.
+    assert (name(_cfg(OVL, overlap_depth=8))
+            == "test_0_1_4_256_256_129_1_8_d8.csv")
+    assert (name(_cfg(OVL, overlap_depth=8, overlap_subblocks=2,
+                      wire="bf16"))
+            == "test_0_1_4_256_256_129_1_8_d8_s2_w1.csv")
+    # depth is RingOverlap-only; the pipelined a2a carries _s alone.
+    assert (name(_pipe_cfg(opt=1)) == "test_1_1_0_256_256_129_1_8_s2.csv")
+
+
+def test_evalkit_parses_overlap_tokens(tmp_path):
+    """The eval layer reduces each schedule variant as its own row: the
+    _d/_s tokens parse out of both filename schemas and land in the
+    variant key + label."""
+    from distributedfft_tpu.evalkit import evaluate as ev
+    m = ev._SLAB_FILE_RE.match("test_0_1_4_256_256_129_1_8_d8_s2_w1.csv")
+    assert m and m.group("depth") == "8" and m.group("sub") == "2"
+    assert m.group("wire") == "1"
+    m = ev._PENCIL_FILE_RE.match(
+        "test_1_1_0_1_0_256_256_129_1_2_4_s2.csv")
+    assert m and m.group("sub") == "2" and m.group("depth") is None
+    # Legacy names still parse with no overlap tokens.
+    m = ev._SLAB_FILE_RE.match("test_0_1_4_256_256_129_1_8.csv")
+    assert m and m.group("depth") is None and m.group("sub") is None
+    lab = ev._variant_label("slab_default_d8_s2")
+    assert "depth=8" in lab[1] and "subblocks=2" in lab[1]

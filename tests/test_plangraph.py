@@ -272,25 +272,30 @@ def test_describe_composes_ring_schedule_bytes():
 
 def test_ring_schedule_depth_parameter():
     """transpose.ring_schedule grew the depth axis (ROADMAP item 3);
-    defaults stay byte-for-byte what PR 10 shipped."""
+    defaults stay byte-for-byte what PR 10 shipped, and the buffer
+    count is honest about the micro-step cap: depth 8 on an 8-rank
+    unsplit ring revolves only 7 buffers."""
     legacy = ring_schedule((256, 256, 129), np.complex64, "bf16", 8,
                            overlap=True)
     assert legacy["buffers"] == 2
     deep = ring_schedule((256, 256, 129), np.complex64, "bf16", 8,
                          overlap=True, depth=8)
-    assert deep["buffers"] == 8
-    assert deep["bytes_in_flight"] == 8 * deep["block_wire_bytes"]
+    assert deep["buffers"] == 7
+    assert deep["effective_depth"] == 7
+    assert deep["bytes_in_flight"] == 7 * deep["block_wire_bytes"]
     with pytest.raises(ValueError):
         ring_schedule((8, 8), np.complex64, "native", 4, depth=0)
 
 
 def test_verify_shipped_depths_sweep():
     rows = schedverify.verify_shipped_depths(8)
-    assert [r["depth"] for r in rows] == [1, 1, 2, 4, 8]
+    assert [(r["depth"], r["subblocks"]) for r in rows] == [
+        (1, 1), (1, 1), (2, 1), (2, 2), (4, 1), (4, 2), (8, 1), (8, 2)]
     assert all(r["ok"] for r in rows)
-    # Honesty about the p-1 buffer cap: an 8-rank ring has 7 steps, so
-    # the depth-8 row exercises only 7 buffers and must say so.
-    assert [r["effective_depth"] for r in rows] == [0, 1, 2, 4, 7]
+    # Honesty about the micro-step buffer cap: an 8-rank unsplit ring
+    # has 7 steps, so the depth-8 split-1 row exercises only 7 buffers
+    # and must say so; the split-2 row has 14 micro-steps and fits 8.
+    assert [r["effective_depth"] for r in rows] == [0, 1, 2, 2, 4, 4, 7, 8]
     assert schedverify.describe(16, 8)["effective_depth"] == 8
 
 

@@ -274,7 +274,7 @@ def test_grad_through_bf16_ring_roundtrip(devices, rng):
 
 
 # ---------------------------------------------------------------------------
-# (d) wisdom schema migration round-trip (current version: 4)
+# (d) wisdom schema migration round-trip (current version: 5)
 # ---------------------------------------------------------------------------
 
 def _legacy_store(tmp_path, version: int):
@@ -284,6 +284,12 @@ def _legacy_store(tmp_path, version: int):
             "mxu_direct_max": None}
     crec = {"comm_method": "All2All", "comm_method2": None, "opt": 1,
             "send_method": None, "streams_chunks": None}
+    if version >= 3:
+        # v3 grew the wire axis; v4 grew the RING_OVERLAP send race.
+        # Neither ever saw the overlap depth/sub-block axes (v5).
+        crec.update(wire_dtype="native", wire_raced=True)
+    if version >= 4:
+        crec.update(send_method="RingOverlap")
     path = tmp_path / f"wisdom_v{version}.json"
     path.write_text(json.dumps({
         "version": version,
@@ -291,15 +297,16 @@ def _legacy_store(tmp_path, version: int):
     return wisdom.WisdomStore(str(path)), key
 
 
-@pytest.mark.parametrize("version", [1, 2, 3])
+@pytest.mark.parametrize("version", [1, 2, 3, 4])
 def test_legacy_store_migrates_to_current(tmp_path, version):
-    """Legacy (v1-v3) stores load as a migrated current-version view:
+    """Legacy (v1-v4) stores load as a migrated current-version view:
     local_fft records carry over verbatim, comm records (raced without
-    the wire axis for v1/v2, without the RING_OVERLAP axis for v3) read
-    as misses; the next record persists the current version on disk."""
+    the wire axis for v1/v2, without the RING_OVERLAP axis for v3,
+    without the overlap depth/sub-block axes for v4) read as misses;
+    the next record persists the current version on disk."""
     store, key = _legacy_store(tmp_path, version)
     data = store.load()
-    assert data["version"] == wisdom.WISDOM_VERSION == 4
+    assert data["version"] == wisdom.WISDOM_VERSION == 5
     assert "comm" not in data["entries"][key]
     assert data["entries"][key]["local_fft"]["fft_backend"] == "xla"
     assert store.lookup(key, "comm") is None
@@ -308,7 +315,7 @@ def test_legacy_store_migrates_to_current(tmp_path, version):
            "wire_dtype": "bf16", "wire_raced": True}
     assert store.record(key, "comm", rec)
     raw = json.loads(open(store.path).read())
-    assert raw["version"] == 4
+    assert raw["version"] == wisdom.WISDOM_VERSION
     assert raw["entries"][key]["comm"]["wire_dtype"] == "bf16"
     assert raw["entries"][key]["local_fft"]["fft_backend"] == "xla"
     # Round-trip: the persisted v3 record folds back with its wire axis.
@@ -393,7 +400,7 @@ def test_wire_auto_resolves_and_records(devices, tmp_path):
     assert plan.config.comm_method is pm.CommMethod.ALL2ALL
     assert plan.config.opt == 1
     raw = json.loads(open(path).read())
-    assert raw["version"] == 4
+    assert raw["version"] == wisdom.WISDOM_VERSION
     (entry,) = [e for e in raw["entries"].values() if "wire" in e]
     assert entry["wire"]["wire_dtype"] == plan.config.wire_dtype
     # Hit path: poison the recorded winner to prove the store answers. A

@@ -85,7 +85,8 @@ BUDGET_S = 450               # parent wall-clock; driver's outer limit is >480
 PROBE_TIMEOUT_S = 180        # re-probe ceiling (first probe rides the budget)
 MESH_TIMEOUT_S = 300
 SERVE_TIMEOUT_S = 90         # serving-layer saturation bench (CPU, bounded)
-FLEET_TIMEOUT_S = 150        # fleet scaling bench (CPU, bounded; ISSUE 13)
+FLEET_TIMEOUT_S = 180        # fleet scaling bench + 3D-volume row (CPU,
+                             # bounded; ISSUE 13 + ISSUE 20)
 SOLVERS_TIMEOUT_S = 75       # solvers suite bench (CPU, bounded; ISSUE 9)
 MEASURE_RESERVE_S = 120      # budget step 3 needs after a successful probe
 # Default sweep covers the BASELINE metric's own sizes (VERDICT r3 item 7:
@@ -1189,6 +1190,51 @@ def _child_fleet(deadline_s: int = FLEET_TIMEOUT_S) -> int:
                     f.close(drain=False, timeout_s=5.0)
         out["scaling"] = rows
         out["shapes"] = [list(s) for s in shapes]
+        # ISSUE 20: ONE 3D-volume row — the serving envelope (admission,
+        # keying, queue, crop-to-logical) around a served slab volume vs
+        # driving the same 8-device SlabFFTPlan by hand in-process. The
+        # served path adds pipe transport + host crop; the row quotes
+        # that overhead honestly rather than hiding it in a sweep.
+        try:
+            n3 = int(os.environ.get("DFFT_BENCH_FLEET_N3", "64"))
+            from distributedfft_tpu import params as pm
+            from distributedfft_tpu.models.slab import SlabFFTPlan
+            from distributedfft_tpu.parallel.mesh import force_cpu_devices
+            force_cpu_devices(8)  # before first backend touch here
+            v = rng.random((n3, n3, n3), dtype=np.float32)
+            f = Fleet(1, worker_backend="server", worker_devices=[8],
+                      heartbeat_interval_s=0.5, cache_capacity=4)
+            try:
+                f.prewarm((n3, n3, n3), transform="r2c")
+                f.request(v, "r2c", timeout_s=300)  # warm the route
+                served = []
+                for _ in range(5):
+                    t1 = time.perf_counter()
+                    f.request(v, "r2c", timeout_s=300)
+                    served.append((time.perf_counter() - t1) * 1e3)
+                f.close(drain=True, timeout_s=30.0)
+            finally:
+                f.close(drain=False, timeout_s=5.0)
+            plan = SlabFFTPlan(pm.GlobalSize(n3, n3, n3),
+                               pm.SlabPartition(8), pm.Config(),
+                               transform="r2c")
+            np.asarray(plan.crop_spectral(plan.exec_r2c(v)))  # warm
+            direct = []
+            for _ in range(5):
+                t1 = time.perf_counter()
+                np.asarray(plan.crop_spectral(plan.exec_r2c(v)))
+                direct.append((time.perf_counter() - t1) * 1e3)
+            sp50 = round(float(np.median(served)), 3)
+            dp50 = round(float(np.median(direct)), 3)
+            out["volume"] = {
+                "shape": [n3, n3, n3], "decomp": "slab",
+                "transform": "r2c", "devices": 8,
+                "served_p50_ms": sp50, "direct_p50_ms": dp50,
+                "envelope_overhead_ms": round(sp50 - dp50, 3),
+                "envelope_overhead_x": round(sp50 / max(dp50, 1e-9), 3),
+            }
+        except Exception as e:  # noqa: BLE001 — the row is optional
+            out["volume"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         import multiprocessing as _mp
         out["host_cores"] = _mp.cpu_count()
         out["note"] = ("open-loop Poisson arrivals (serve_load) against "

@@ -13,6 +13,7 @@ one MPI sub-communicator).
 from __future__ import annotations
 
 import math
+import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -22,6 +23,16 @@ from jax.sharding import Mesh
 
 SLAB_AXIS = "p"
 PENCIL_AXES = ("p1", "p2")
+
+# One process-wide mutex serializing COLLECTIVE launches across threads.
+# XLA's in-process cross-device rendezvous assumes one program at a time
+# per local device set: two threads interleaving all-to-alls on the same
+# mesh (a resident solver stepping while the serving thread executes a
+# volume plan — possible since mesh workers host both) park participants
+# of different run_ids at the same rendezvous and deadlock. Reentrant so
+# a guarded caller can call guarded helpers. Single-threaded device use
+# never contends; holders pay one uncontended acquire.
+DEVICE_LOCK = threading.RLock()
 
 
 def force_cpu_devices(n: int) -> None:

@@ -28,8 +28,9 @@ from __future__ import annotations
 from .checkpoint import (CHECKPOINT_VERSION, CheckpointCorrupt,
                          CheckpointError, CheckpointMismatch,
                          CheckpointMissing, CheckpointStore,
-                         CheckpointUnusable, GENERATION_SLOTS, SimState,
-                         crc32c, fingerprint_mismatch, read_checkpoint,
+                         CheckpointUnusable, GENERATION_SLOTS,
+                         MESH_CHANGE_FIELDS, SimState, crc32c,
+                         fingerprint_mismatch, read_checkpoint,
                          write_checkpoint)
 from .policy import CheckpointPolicy
 from .state import capture, plan_fingerprint, restore, wisdom_provenance
@@ -56,7 +57,7 @@ __all__ = [
     "CHECKPOINT_VERSION", "GENERATION_SLOTS", "ENV_DIR", "ENV_POLICY",
     "CheckpointError", "CheckpointCorrupt", "CheckpointMissing",
     "CheckpointMismatch", "CheckpointUnusable", "CheckpointPolicy",
-    "CheckpointStore", "SimState", "capture", "crc32c",
+    "CheckpointStore", "MESH_CHANGE_FIELDS", "SimState", "capture", "crc32c",
     "fingerprint_mismatch", "plan_fingerprint", "read_checkpoint",
     "resolve_env", "restore", "wisdom_provenance",
 ]

@@ -370,6 +370,14 @@ def read_checkpoint(path: str) -> SimState:
 GENERATION_SLOTS = ("ckpt-a.dfft", "ckpt-b.dfft")
 
 
+# The fingerprint fields a MESH CHANGE (and nothing else) flips: rank
+# count, the sequence the autotuner picked for the new rank count, and
+# the variant label derived from both. An ``allow_mesh_change`` restore
+# tolerates diffs confined to this set — shape, transform, dtype, comm
+# and backend disagreements remain configuration errors and refuse.
+MESH_CHANGE_FIELDS = frozenset({"ranks", "sequence", "variant"})
+
+
 def fingerprint_mismatch(stored: Dict[str, Any],
                          current: Dict[str, Any]
                          ) -> Dict[str, Tuple[Any, Any]]:
@@ -457,8 +465,8 @@ class CheckpointStore:
         obs.metrics.gauge("persist.last_checkpoint_age_s", 0.0)
         return path
 
-    def load(self, expect_fingerprint: Optional[Dict[str, Any]] = None
-             ) -> SimState:
+    def load(self, expect_fingerprint: Optional[Dict[str, Any]] = None,
+             allow_mesh_change: bool = False) -> SimState:
         """The newest fully-valid generation, newest-step-first with
         exactly-one-generation fallback on corruption
         (``persist.generation_fallbacks`` + the
@@ -467,6 +475,18 @@ class CheckpointStore:
         plan's ``persist.plan_fingerprint``) refuses a mismatched
         checkpoint with :class:`CheckpointMismatch` — no fallback: a
         fingerprint disagreement is configuration, not corruption.
+
+        ``allow_mesh_change=True`` is the shrink-and-replan escape
+        hatch (ISSUE 20): a diff confined to :data:`MESH_CHANGE_FIELDS`
+        (rank count + the sequence/variant that follow from it) loads
+        anyway — the state is re-placed into the CURRENT plan's
+        sharding by ``persist.restore`` — with the two-tier numerical
+        contract: same mesh stays bit-exact (this branch never fires),
+        changed mesh is allclose under the Parseval guard. NEVER
+        silent: the tolerated diff is recorded as a structured
+        ``persist.degraded_restore`` event + counter. Any diff outside
+        the mesh set still raises :class:`CheckpointMismatch`.
+
         Raises :class:`CheckpointMissing` when no generation file
         exists, :class:`CheckpointUnusable` when all that exist fail
         validation."""
@@ -521,6 +541,19 @@ class CheckpointStore:
                 # and describe() must render the same verdict.
                 diffs = fingerprint_mismatch(state.plan_fingerprint,
                                              expect_fingerprint)
+                if diffs and allow_mesh_change \
+                        and set(diffs) <= MESH_CHANGE_FIELDS:
+                    obs.metrics.inc("persist.degraded_restores")
+                    obs.event(
+                        "persist.degraded_restore", path=path,
+                        step=int(state.step),
+                        diffs={k: list(v) for k, v in sorted(diffs.items())})
+                    obs.notice(
+                        "persist: restoring across a mesh change "
+                        f"({', '.join(f'{k}: {v[0]!r} -> {v[1]!r}' for k, v in sorted(diffs.items()))}) "
+                        "— allclose contract, not bit-exact",
+                        name="persist.degraded_restore")
+                    diffs = {}
                 if diffs:
                     obs.metrics.inc("persist.restore_failures")
                     flightrec.trigger(

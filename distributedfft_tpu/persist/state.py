@@ -76,13 +76,37 @@ def capture(solver: Any, state: StateTree, step: int, dt: float, *,
                     wisdom=wisdom_provenance(plan), meta=meta_out)
 
 
+def _fit_padded(host: np.ndarray, plan: Any) -> np.ndarray:
+    """Adapt a captured global spectral array to the CURRENT plan's
+    padded shape (the mesh-change restore path: a different rank count
+    pads decomposed axes to a different multiple). The logical region
+    is p-independent, and the slab/pencil padded-shape contract says pad
+    lanes are exact zeros in forward output — so crop to the logical
+    extents and zero-pad back out. Same shape (every same-mesh restore,
+    and mesh-divisible sizes across any mesh change) returns ``host``
+    UNTOUCHED, preserving the bit-exact contract byte for byte."""
+    padded = getattr(plan, "output_padded_shape", None)
+    if padded is None or tuple(host.shape) == tuple(padded):
+        return host
+    logical = tuple(getattr(plan, "output_shape", padded))
+    if len(logical) != host.ndim or len(padded) != host.ndim:
+        return host  # a rank disagreement is for the device_put to refuse
+    cropped = host[tuple(slice(0, min(h, l))
+                         for h, l in zip(host.shape, logical))]
+    pad = [(0, p - s) for p, s in zip(padded, cropped.shape)]
+    return np.pad(cropped, pad) if any(w for _, w in pad) else cropped
+
+
 def restore(sim: SimState, solver: Any) -> StateTree:
     """Re-place a validated :class:`SimState` onto the devices in the
     CURRENT plan's spectral sharding; returns the solver-shaped state
     pytree (tuple for multi-field solvers). Raises ``ValueError`` when
     the checkpoint's field count disagrees with what it recorded —
     format-level corruption is already excluded by the checksum pass,
-    so this only fires on a hand-edited header."""
+    so this only fires on a hand-edited header. A checkpoint captured
+    on a DIFFERENT mesh (``CheckpointStore.load(allow_mesh_change=
+    True)`` admitted it) is shape-adapted through :func:`_fit_padded`
+    before placement."""
     import jax
     n = int(sim.meta.get("n_fields", len(sim.arrays)))
     names = [_FIELD.format(i) for i in range(n)]
@@ -93,7 +117,7 @@ def restore(sim: SimState, solver: Any) -> StateTree:
     sharding = getattr(solver.plan, "output_sharding", None)
     leaves = []
     for nm in names:
-        host = sim.arrays[nm]
+        host = _fit_padded(sim.arrays[nm], solver.plan)
         if sharding is not None:
             leaves.append(jax.device_put(host, sharding))
         else:

@@ -34,6 +34,18 @@ same live server)::
                              # MS milliseconds (default 60000) per message
                              # — exercises the K-missed-heartbeats path
                              # (vs crash's broken-pipe path)
+    worker:devloss[:D]       # fleet worker @seed=I dies abruptly (like
+                             # crash) AND its replacement can only
+                             # acquire D fewer devices (default 1) — the
+                             # accelerator really is gone, so the
+                             # replacement must come back on a SHRUNKEN
+                             # mesh, rebuild its hot plans there, and
+                             # restore residents across the mesh change
+                             # (the shrink-and-replan drill). The kill
+                             # fires on receipt of the
+                             # $DFFT_DEVLOSS_AFTER-th request (default
+                             # 1); the parent fleet reads the same spec
+                             # via devloss_cut() when sizing respawns
     checkpoint:torn[:BYTES]  # every landed checkpoint write loses its
                              # last BYTES bytes (default 64) — a torn
                              # write the filesystem lost mid-rename; the
@@ -88,7 +100,7 @@ _WIRE_MODES = ("nan", "bitflip", "scale")
 _KINDS = {
     "wire": _WIRE_MODES,
     "server": ("slow",),
-    "worker": ("crash", "hang"),
+    "worker": ("crash", "hang", "devloss"),
     "checkpoint": ("torn", "corrupt", "stale"),
     "coordinator": ("down",),
     "wisdom": ("stale-lock",),
@@ -310,6 +322,48 @@ def maybe_crash_worker(index: int, generation: int = 0) -> None:
         obs.metrics.inc("inject.worker_crashes")
         obs.event("inject.worker_crash", worker=int(index), after=k)
         os._exit(17)
+
+
+def maybe_devloss_worker(index: int, generation: int = 0) -> None:
+    """Worker-side half of ``worker:devloss[:D]``: the victim (index ==
+    seed, generation 0 only — same gating as ``worker:crash``) exits
+    abruptly on receipt of its ``$DFFT_DEVLOSS_AFTER``-th request
+    (default 1, i.e. the first), exactly like a crash. The spec's param
+    D is NOT consumed here — it is the number of devices the
+    REPLACEMENT comes up short, read by the parent fleet through
+    :func:`devloss_cut` when it sizes the respawn. The env knob (rather
+    than a second grammar param) lets a chaos drive let a few requests —
+    and the resident's first checkpoint — land before the loss."""
+    spec = _spec_of("worker")
+    if spec is None or spec.mode != "devloss":
+        return
+    if generation != 0 or int(index) != spec.seed:
+        return
+    _WORKER_REQS[0] += 1
+    after = max(1, int(os.environ.get("DFFT_DEVLOSS_AFTER", "1")))
+    if _WORKER_REQS[0] >= after:
+        obs.metrics.inc("inject.worker_devlosses")
+        obs.event("inject.worker_devloss", worker=int(index), after=after,
+                  devices_lost=1 if spec.param is None
+                  else max(1, int(spec.param)))
+        os._exit(18)
+
+
+def devloss_cut(index: int, generation: int = 0) -> int:
+    """Parent-side half of ``worker:devloss[:D]``: how many devices the
+    generation-``generation`` incarnation of worker ``index`` must come
+    up SHORT (0 when no devloss fault targets it). Generation 0 — the
+    victim — spawns at full size; every respawn while the spec is
+    active acquires D fewer devices, emulating a host whose accelerator
+    is physically gone. Clearing ``$DFFT_FAULT_SPEC`` 'repairs' the
+    host: the next (re)spawn is full-size again and rejoins through the
+    normal join path."""
+    spec = _spec_of("worker")
+    if spec is None or spec.mode != "devloss":
+        return 0
+    if int(index) != spec.seed or generation < 1:
+        return 0
+    return 1 if spec.param is None else max(1, int(spec.param))
 
 
 def maybe_hang_worker(index: int, generation: int = 0) -> None:

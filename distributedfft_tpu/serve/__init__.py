@@ -30,7 +30,7 @@ exactly that).
 from . import plancache
 from .fleet import Fleet, RemoteWorkerError, ScaleController
 from .plancache import (PlanCache, bucket_for, cache_key,
-                        parse_request_key, request_key)
+                        parse_request_key, request_key, request_key3d)
 from .resident import ResidentSolver
 from .router import FairQueue, RendezvousRing, TenantPolicy
 from .server import Overloaded, Server, ServerClosed, normalize_request
@@ -40,36 +40,51 @@ __all__ = [
     "RendezvousRing", "ResidentSolver", "ScaleController", "Server",
     "ServerClosed", "TenantPolicy", "bucket_for", "cache_key",
     "describe_request", "normalize_request", "parse_request_key",
-    "plancache", "request_key",
+    "plancache", "request_key", "request_key3d",
 ]
 
 
-def describe_request(nx: int, ny: int, *, double: bool = False,
+def describe_request(nx: int, ny: int, nz=None, *, double: bool = False,
                      transform: str = "r2c", shard: str = "batch",
-                     config=None, circuit_k: int = 3,
+                     decomp: str = "slab", config=None, circuit_k: int = 3,
                      circuit_cooldown_s: float = 5.0,
                      max_coalesce: int = 8) -> list:
     """The ``dfft-explain`` ``serve:`` section: for one request shape,
     the plan-cache key it would occupy, its coalescing eligibility, and
     the circuit/ladder policy that would wrap its execution — all static
     (nothing is built or executed), reusing the same key and ladder
-    machinery the live server uses."""
+    machinery the live server uses. A 3D shape (``nz`` given) describes
+    the volume form: the ``fft3d`` key family, single-shot execution on
+    ``decomp``, no coalescing."""
     from ..resilience import fallback
     from ..utils.wisdom import _describe_comm
     code = "f64" if double else "f32"
-    base = request_key(nx, ny, code, transform, shard)
-    buckets = []
-    top = bucket_for(max_coalesce, max_coalesce)
-    b = 1
-    while b <= top:
-        buckets.append(str(b))
-        b <<= 1
-    lines = [
-        f"  request key: {base}",
-        f"  plan cache slots: {base}#b{{{','.join(buckets)}}} "
-        "(LRU, power-of-two coalescing buckets)",
-    ]
-    if shard == "batch":
+    if nz is not None:
+        base = request_key3d(nx, ny, int(nz), code, transform, decomp)
+        lines = [
+            f"  request key: {base}",
+            f"  plan cache slots: {base} (single slot — volumes are "
+            "single-shot, no coalescing buckets)",
+            f"  coalescing: not eligible — 3D volumes execute one-shot "
+            f"through the {decomp} plan family (no batch axis to stack "
+            "along); concurrent volumes queue behind each other",
+        ]
+    else:
+        base = request_key(nx, ny, code, transform, shard)
+        buckets = []
+        top = bucket_for(max_coalesce, max_coalesce)
+        b = 1
+        while b <= top:
+            buckets.append(str(b))
+            b <<= 1
+        lines = [
+            f"  request key: {base}",
+            f"  plan cache slots: {base}#b{{{','.join(buckets)}}} "
+            "(LRU, power-of-two coalescing buckets)",
+        ]
+    if nz is not None:
+        pass
+    elif shard == "batch":
         lines.append(
             f"  coalescing: eligible — same-key requests stack along the "
             f"batch axis (up to {max_coalesce}; batch_chunk=1 per-plane "

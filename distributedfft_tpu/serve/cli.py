@@ -13,12 +13,13 @@ Two complementary surfaces over one in-process :class:`Server`:
   dependencies): ``GET /healthz`` returns the health snapshot JSON,
   ``GET /readyz`` answers 200 only while the server admits work (503
   when draining/stopped — the load-balancer contract), and
-  ``POST /fft`` executes one request: body is an ``.npy`` payload,
-  headers ``X-DFFT-Transform`` (r2c|c2c), ``X-DFFT-Direction``
-  (forward|inverse), ``X-DFFT-Ny`` (inverse r2c logical width) and
-  ``X-DFFT-Deadline-Ms`` select the work; rejections map to structured
-  status codes (429 Overloaded, 503 circuit open / closed, 504 deadline
-  exceeded).
+  ``POST /fft`` executes one request: body is an ``.npy`` payload
+  (2D image or 3D volume), headers ``X-DFFT-Transform`` (r2c|c2c),
+  ``X-DFFT-Direction`` (forward|inverse), ``X-DFFT-Ny`` (inverse r2c
+  logical width of the halved last axis), ``X-DFFT-Decomp``
+  (slab|pencil — volume payloads only) and ``X-DFFT-Deadline-Ms``
+  select the work; rejections map to structured status codes (429
+  Overloaded, 503 circuit open / closed, 504 deadline exceeded).
 
 SIGTERM/SIGINT trigger a GRACEFUL DRAIN: in-flight and queued work
 finishes, new admissions are rejected with ``ServerClosed``, wisdom and
@@ -41,6 +42,8 @@ Examples::
     dfft-serve --drive --workers 3 --rate 60 --duration 10 \
         --shapes 64x64 --tenants gold,free --tenant-weights gold=3
     dfft-serve --drive --autoscale 1:4 --rate 120 --duration 20
+    dfft-serve --drive --workers 3 --worker-devices 8,0,0 \
+        --shapes 64x64x64,256x256 --rate 20 --duration 10
 """
 
 from __future__ import annotations
@@ -109,6 +112,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--workers", type=int, default=0,
                     help="run a fleet of N subprocess workers behind the "
                          "plan-key router (0 = single in-process server)")
+    ap.add_argument("--worker-devices", default=None, metavar="D0,D1,...",
+                    help="per-worker CPU-emulated device counts, e.g. "
+                         "'8,0,0' = worker 0 is an 8-device mesh worker "
+                         "(serves fft3d/* volume keys), the rest fall "
+                         "back to --emulate-devices (fleet mode)")
+    ap.add_argument("--volume-decomp", default="slab",
+                    choices=("slab", "pencil"),
+                    help="default 3D decomposition of served volume "
+                         "requests (per-request override: submit "
+                         "decomp= / X-DFFT-Decomp)")
     ap.add_argument("--worker-backend", default="server",
                     choices=("server", "stub"),
                     help="fleet worker core: the real jax Server, or the "
@@ -176,8 +189,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="drive a fixed request count instead of "
                          "--duration")
     ap.add_argument("--shapes", default="256x256",
-                    help="comma-separated NXxNY request shapes the "
-                         "traffic mixes over")
+                    help="comma-separated NXxNY (image) or NXxNYxNZ "
+                         "(volume) request shapes the traffic mixes "
+                         "over")
     ap.add_argument("--dtypes", default="f32",
                     help="comma-separated payload dtypes (f32,f64)")
     ap.add_argument("--transforms", default="r2c",
@@ -209,6 +223,20 @@ def _parse_tenant_weights(s):
             raise SystemExit(f"--tenant-weights weight not a number: "
                              f"{tok!r}") from None
     return out or None
+
+
+def _parse_worker_devices(s):
+    if not s:
+        return None
+    try:
+        out = [int(tok) for tok in s.split(",") if tok.strip()]
+    except ValueError:
+        raise SystemExit(f"--worker-devices wants comma-separated "
+                         f"integers, got {s!r}") from None
+    if not out or any(d < 0 for d in out):
+        raise SystemExit(f"--worker-devices counts must be >= 0, got "
+                         f"{s!r}")
+    return out
 
 
 def _parse_autoscale(s):
@@ -266,13 +294,23 @@ def _parse_resident(args):
 
 
 def _parse_shapes(s: str):
+    """``NXxNY`` image and ``NXxNYxNZ`` volume entries, mixed freely;
+    a bare ``N`` means ``NxN``."""
     out = []
     for part in s.split(","):
         part = part.strip().lower()
         if not part:
             continue
-        nx, _, ny = part.partition("x")
-        out.append((int(nx), int(ny or nx)))
+        dims = [tok for tok in part.split("x") if tok]
+        if len(dims) not in (1, 2, 3):
+            raise SystemExit(f"--shapes wants NXxNY or NXxNYxNZ, got "
+                             f"{part!r}")
+        try:
+            shape = tuple(int(d) for d in dims)
+        except ValueError:
+            raise SystemExit(f"--shapes sizes must be integers, got "
+                             f"{part!r}") from None
+        out.append(shape * 2 if len(shape) == 1 else shape)
     if not out:
         raise SystemExit("--shapes needs at least one NXxNY entry")
     return out
@@ -333,10 +371,12 @@ def _make_http(server, port: int):
                 transform = self.headers.get("X-DFFT-Transform", "r2c")
                 direction = self.headers.get("X-DFFT-Direction", "forward")
                 ny = self.headers.get("X-DFFT-Ny")
+                decomp = self.headers.get("X-DFFT-Decomp")
                 ddl = self.headers.get("X-DFFT-Deadline-Ms")
                 fut = server.submit(
                     x, transform, direction,
                     ny=int(ny) if ny else None,
+                    decomp=decomp or None,
                     deadline_ms=float(ddl) if ddl else None)
                 # The admission trace id: one request's whole path
                 # (admit -> coalesce -> execute -> reply) is
@@ -386,6 +426,12 @@ def main(argv=None) -> int:
 
     from .. import obs
     if args.obs_dir:
+        # Export too, not just enable(): fleet WORKERS are spawned
+        # subprocesses that only see the environment — without this the
+        # worker-side half of the evidence chain (persist.checkpoint,
+        # persist.degraded_restore, ...) silently never lands in the
+        # one obs dir the flag promises.
+        os.environ["DFFT_OBS_DIR"] = args.obs_dir
         obs.enable(args.obs_dir)
     if args.obs:
         obs.enable_console()
@@ -425,6 +471,8 @@ def main(argv=None) -> int:
             heartbeat_interval_s=args.heartbeat_interval_s,
             heartbeat_k=args.heartbeat_k,
             worker_inflight=args.worker_inflight,
+            worker_devices=_parse_worker_devices(args.worker_devices),
+            volume_decomp=args.volume_decomp,
             tenant_weights=_parse_tenant_weights(args.tenant_weights),
             resident=resident_spec,
             **server_kwargs)
@@ -433,6 +481,9 @@ def main(argv=None) -> int:
                 server, autoscale[0], autoscale[1],
                 cooldown_s=args.scale_cooldown_s))
     else:
+        if args.worker_devices:
+            raise SystemExit("--worker-devices requires fleet mode "
+                             "(--workers N or --autoscale MIN:MAX)")
         if args.tenants or args.tenant_weights:
             # Server.submit has no tenant axis: forwarding the flag
             # would TypeError every request into a silent 100%-failed
@@ -440,7 +491,9 @@ def main(argv=None) -> int:
             raise SystemExit("--tenants/--tenant-weights require fleet "
                              "mode (--workers N or --autoscale MIN:MAX)")
         server = Server(pm.SlabPartition(args.partitions), cfg,
-                        shard=args.shard, **server_kwargs)
+                        shard=args.shard,
+                        volume_decomp=args.volume_decomp,
+                        **server_kwargs)
         if resident_spec is not None:
             from .. import persist
             from .resident import ResidentSolver

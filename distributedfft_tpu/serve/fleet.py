@@ -35,18 +35,40 @@ existing hardened ``Server`` core, behind a router that:
 Worker protocol (pickled tuples over a duplex pipe)::
 
     parent -> worker   ("req", tid, {...})  ("ping", seq)
-                       ("prewarm", [(nx, ny, dtype, transform), ...])
+                       ("prewarm", [(nx, ny, dtype, transform) |
+                                    (nx, ny, nz, dtype, transform,
+                                     decomp), ...])
                        ("drain",)  ("stop",)
     worker -> parent   ("ready", pid, generation)  ("pong", seq, stats)
                        ("res", tid, "ok", array | "err", encoded)
                        ("prewarmed", n)  ("drained", stats)
+
+Elastic volume serving (ISSUE 20): a worker spec carries a per-worker
+``devices=N`` mesh size (``worker_devices=[8, 0, 0]`` sizes worker 0 to
+an 8-device CPU-emulated mesh and leaves the rest at the fleet
+default), and routing is CAPABILITY-AWARE — ``fft3d/*`` volume keys
+rendezvous-hash over the mesh-capable workers only (a second
+``RendezvousRing`` with the same minimum-movement stability), 2D keys
+over everyone. Each worker's heartbeat carries its live device count
+into the ``dfft_fleet_worker_devices{worker=...}`` gauge, ``health()``
+reports ``degraded`` while any worker runs short of its spec'd size,
+and the ``fleet.capacity`` gauge weights workers by acquired/spec'd
+devices so the scale controller sees a 4-of-8-device worker as half a
+worker.
 
 Chaos hooks: ``$DFFT_FAULT_SPEC`` ``worker:crash[:K]`` /
 ``worker:hang[:MS]`` (``resilience/inject.py``) fault the victim
 worker's FIRST incarnation from inside its message loop, driving the
 broken-pipe and missed-beats detector paths respectively; the fleet
 must complete the drive with zero lost requests (CI's fleet chaos
-scenario and ``tests/test_fleet.py`` pin this).
+scenario and ``tests/test_fleet.py`` pin this). ``worker:devloss[:D]``
+kills the victim like a crash AND makes every respawn acquire D fewer
+devices (``inject.devloss_cut`` — the parent reads the same spec when
+sizing the replacement), driving the shrink-and-replan path: the
+replacement rebuilds its hot plans on the smaller mesh and restores a
+resident solver across the mesh change
+(``persist.load(allow_mesh_change=True)`` → ``persist.degraded_restore``
+evidence).
 
 ``worker_backend="stub"`` swaps the jax-backed ``Server`` core for a
 protocol-identical ``np.fft`` stub with a fixed service time — the
@@ -156,8 +178,12 @@ class _StubCore:
 
     def submit(self, x: Any, transform: str = "r2c",
                direction: str = "forward", *, ny: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> Future:
-        x, nx, ny_, _ = normalize_request(x, transform, direction, ny)
+               deadline_ms: Optional[float] = None,
+               decomp: Optional[str] = None) -> Future:
+        # decomp only picks the served plan family; the np.fft twin has
+        # no mesh, so it is validated-and-ignored (routing happens on
+        # the PARENT side — the stub exists to test exactly that).
+        x, shape, _ = normalize_request(x, transform, direction, ny)
         dl = Deadline.after_ms(deadline_ms) if deadline_ms else None
         fut: Future = Future()
         with self._lock:
@@ -167,7 +193,8 @@ class _StubCore:
                 self._counts["shed"] += 1
                 raise Overloaded("queue_full", len(self._pending), 0.0,
                                  float(self.max_queue))
-            self._pending.append(((x, transform, direction, ny_, dl), fut))
+            self._pending.append(((x, transform, direction, shape, dl),
+                                  fut))
             self._cv.notify()
         return fut
 
@@ -178,7 +205,7 @@ class _StubCore:
                     self._cv.wait(0.05)
                 if not self._pending:
                     return
-                (x, transform, direction, ny, dl), fut = \
+                (x, transform, direction, shape, dl), fut = \
                     self._pending.pop(0)
             if dl is not None and dl.expired():
                 with self._lock:
@@ -189,21 +216,24 @@ class _StubCore:
                 continue
             time.sleep(self.service_ms / 1e3)
             try:
+                # n-dimensional: rfftn == rfft2 on a 2D image, and the
+                # same dispatch serves 3D volumes (unnormalized inverse,
+                # Server-style).
                 if direction == "forward":
-                    out = (np.fft.rfft2(x) if transform == "r2c"
-                           else np.fft.fft2(x))
-                elif transform == "r2c":   # unnormalized, Server-style
-                    out = np.fft.irfft2(x, s=(x.shape[0], ny)) \
-                        * (x.shape[0] * ny)
+                    out = (np.fft.rfftn(x) if transform == "r2c"
+                           else np.fft.fftn(x))
+                elif transform == "r2c":
+                    out = np.fft.irfftn(x, s=shape) \
+                        * float(np.prod(shape))
                 else:
-                    out = np.fft.ifft2(x) * x.size
+                    out = np.fft.ifftn(x) * x.size
                 with self._lock:
                     self._counts["served"] += 1
                 fut.set_result(np.ascontiguousarray(out))
             except Exception as e:  # noqa: BLE001 — worker loop ships it
                 fut.set_exception(e)
 
-    def prewarm(self, shape: Tuple[int, int], dtype: Any = None,
+    def prewarm(self, shape: Tuple[int, ...], dtype: Any = None,
                 transform: str = "r2c", **kw: Any) -> int:
         return 0
 
@@ -227,9 +257,13 @@ class _StubCore:
             self._state = "stopped"
 
 
-def _stats_lite(core: Any) -> Dict[str, Any]:
+def _stats_lite(core: Any, devices: Optional[int] = None
+                ) -> Dict[str, Any]:
     """The heartbeat payload: the queue/EMA/shed signals the router folds
-    into its ``/metrics`` surface for the scale controller."""
+    into its ``/metrics`` surface for the scale controller, plus the
+    worker's LIVE device count (what it actually acquired — after a
+    devloss respawn this is smaller than the spec, and the router's
+    ``dfft_fleet_worker_devices`` gauge shows the dip)."""
     h = core.health()
     c = h.get("counters", {})
     out = {"status": h.get("status"),
@@ -238,6 +272,8 @@ def _stats_lite(core: Any) -> Dict[str, Any]:
            "served": c.get("served", 0), "shed": c.get("shed", 0),
            "deadline_expired": c.get("deadline_expired", 0),
            "batch_failures": c.get("batch_failures", 0)}
+    if devices is not None:
+        out["devices"] = int(devices)
     res = h.get("resident")
     if res:
         # The resident's progress rides the heartbeat so the ROUTER's
@@ -262,22 +298,35 @@ def _worker_main(conn: Any, spec: Dict[str, Any]) -> None:
     # process-level scaling is real on a shared-core host.
     for k, v in (spec.get("env") or {}).items():
         os.environ[str(k)] = str(v)
-    if spec.get("emulate_devices"):
+    # Mesh sizing: a per-worker ``devices`` spec (the capability-aware
+    # fleet's lever — and, after a devloss, the SHRUNKEN size the parent
+    # computed) overrides the fleet-wide ``emulate_devices`` default.
+    devices = int(spec.get("devices") or 0)
+    if devices or spec.get("emulate_devices"):
         from ..parallel.mesh import force_cpu_devices
-        force_cpu_devices(int(spec["emulate_devices"]))
+        force_cpu_devices(devices or int(spec["emulate_devices"]))
     index, generation = int(spec["index"]), int(spec["generation"])
     if spec.get("backend") == "stub":
         core: Any = _StubCore(
             service_ms=float(spec.get("stub_service_ms", 5.0)),
             max_queue=int(spec.get("server_kwargs", {})
                           .get("max_queue", 64)))
+        ndev = devices or 1
     else:
         from .. import params as pm
         from .server import Server
         part = spec.get("partition") or pm.SlabPartition(1)
+        if devices > 1:
+            # A sized mesh worker partitions over EVERY device it
+            # acquired — including the smaller count a devloss
+            # replacement came back with (the replan half of
+            # shrink-and-replan).
+            part = pm.SlabPartition(devices)
         cfg = spec.get("config") or pm.Config()
         core = Server(part, cfg, shard=spec.get("shard", "batch"),
                       name=spec["name"], **spec.get("server_kwargs", {}))
+        import jax
+        ndev = len(jax.devices())
     # Resident solver tenant (ISSUE 14): build — and, when its
     # checkpoint store already holds a generation, RESTORE — the
     # standing simulation BEFORE announcing ready, so a replacement
@@ -300,14 +349,22 @@ def _worker_main(conn: Any, spec: Dict[str, Any]) -> None:
             except (OSError, ValueError, BrokenPipeError):
                 pass  # parent gone; the recv loop will exit on EOF
 
-    def _prewarm(shapes: List[Tuple[int, int, str, str]]) -> int:
+    def _prewarm(shapes: List[Tuple[Any, ...]]) -> int:
         built = 0
-        for nx, ny, code, transform in shapes:
+        for item in shapes:
             try:
-                built += core.prewarm(
-                    (int(nx), int(ny)),
-                    dtype="float64" if code == "f64" else "float32",
-                    transform=transform)
+                if len(item) == 6:  # (nx, ny, nz, code, transform, decomp)
+                    nx, ny, nz, code, transform, dec = item
+                    built += core.prewarm(
+                        (int(nx), int(ny), int(nz)),
+                        dtype="float64" if code == "f64" else "float32",
+                        transform=transform, decomp=dec)
+                else:
+                    nx, ny, code, transform = item
+                    built += core.prewarm(
+                        (int(nx), int(ny)),
+                        dtype="float64" if code == "f64" else "float32",
+                        transform=transform)
             except Exception:  # noqa: BLE001 — a failed prewarm is a
                 pass           # cold first request, not a dead worker
         return built
@@ -334,18 +391,20 @@ def _worker_main(conn: Any, spec: Dict[str, Any]) -> None:
         kind = msg[0]
         if kind == "req":
             inject.maybe_crash_worker(index, generation)
+            inject.maybe_devloss_worker(index, generation)
             tid, req = msg[1], msg[2]
             try:
                 fut = core.submit(req["x"], req["transform"],
                                   req["direction"], ny=req.get("ny"),
-                                  deadline_ms=req.get("deadline_ms"))
+                                  deadline_ms=req.get("deadline_ms"),
+                                  decomp=req.get("decomp"))
             except Exception as e:  # noqa: BLE001 — structured transport
                 send(("res", tid, "err", _encode_error(e)))
             else:
                 fut.add_done_callback(
                     lambda f, tid=tid: _reply(tid, f))
         elif kind == "ping":
-            send(("pong", msg[1], _stats_lite(core)))
+            send(("pong", msg[1], _stats_lite(core, devices=ndev)))
         elif kind == "prewarm":
             # OFF the pipe loop: a prewarm compiles for seconds, and a
             # worker that stops answering pings while it compiles would
@@ -358,7 +417,7 @@ def _worker_main(conn: Any, spec: Dict[str, Any]) -> None:
                 daemon=True).start()
         elif kind == "drain":
             core.close(drain=True)
-            send(("drained", _stats_lite(core)))
+            send(("drained", _stats_lite(core, devices=ndev)))
             break
         elif kind == "stop":
             core.close(drain=False)
@@ -378,7 +437,7 @@ class _FleetRequest:
     x: np.ndarray
     transform: str
     direction: str
-    ny: int
+    ny: int  # logical extent of the (possibly halved) LAST axis
     key: str
     tenant: str
     deadline: Optional[Deadline]
@@ -386,16 +445,25 @@ class _FleetRequest:
     trace_id: str
     submitted_at: float
     attempts: int = 0
+    decomp: Optional[str] = None  # volumes only: slab | pencil
 
 
 class _Worker:
     """Router-side handle of one worker process."""
 
     def __init__(self, name: str, index: int, generation: int,
-                 proc: Any, conn: Any, policy: TenantPolicy):
+                 proc: Any, conn: Any, policy: TenantPolicy,
+                 devices: int = 0, full_devices: int = 0):
         self.name = name
         self.index = index
         self.generation = generation
+        # devices: the mesh size this incarnation was spawned at;
+        # full_devices: the spec'd size. devices < full_devices means a
+        # devloss replacement running short — health() reports degraded
+        # and fleet.capacity weights it fractionally until a full-size
+        # replacement rejoins.
+        self.devices = int(devices)
+        self.full_devices = int(full_devices)
         self.proc = proc
         self.conn = conn
         self.lock = threading.Lock()
@@ -468,7 +536,10 @@ class Fleet:
 
     def __init__(self, n_workers: int = 2, *, partition: Any = None,
                  config: Any = None, shard: str = "batch",
-                 emulate_devices: int = 0, worker_backend: str = "server",
+                 emulate_devices: int = 0,
+                 worker_devices: Optional[List[int]] = None,
+                 volume_decomp: str = "slab",
+                 worker_backend: str = "server",
                  stub_service_ms: float = 5.0,
                  heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
                  heartbeat_k: int = HEARTBEAT_K,
@@ -486,8 +557,22 @@ class Fleet:
             raise ValueError("n_workers must be >= 1")
         if worker_backend not in ("server", "stub"):
             raise ValueError("worker_backend must be 'server' or 'stub'")
+        if volume_decomp not in plancache.VOLUME_DECOMPS:
+            raise ValueError(f"volume_decomp must be one of "
+                             f"{plancache.VOLUME_DECOMPS}, "
+                             f"got {volume_decomp!r}")
         self.name = name
         self.shard = shard
+        self.volume_decomp = volume_decomp
+        # Per-worker-INDEX mesh sizes (0 = the fleet-wide default); an
+        # index past the list (scale-up mints new indices) gets the
+        # default too. devices > 1 makes a worker MESH-CAPABLE: it joins
+        # the volume routing ring and serves fft3d/* keys.
+        self._worker_devices = [int(d) for d in (worker_devices or [])]
+        self._emulate_devices = int(emulate_devices)
+        self._volume_capable = (self._emulate_devices > 1
+                                or any(d > 1
+                                       for d in self._worker_devices))
         self.worker_inflight = max(1, int(worker_inflight))
         self.worker_pending = max(1, int(worker_pending))
         self.max_resubmits = int(max_resubmits)
@@ -499,6 +584,14 @@ class Fleet:
                else n_workers * self.worker_pending)
         self.policy = TenantPolicy(cap, tenant_weights)
         self.ring = RendezvousRing()
+        # The capability ring: fft3d/* volume keys rendezvous-hash over
+        # the mesh-capable members ONLY (2D keys over self.ring — every
+        # worker). Same minimum-movement stability, per capability
+        # class.
+        self.mesh_ring = RendezvousRing()
+        if worker_backend == "server":
+            server_kwargs = dict(server_kwargs,
+                                 volume_decomp=volume_decomp)
         self._spec_base = {
             "partition": partition, "config": config, "shard": shard,
             "emulate_devices": int(emulate_devices),
@@ -572,27 +665,68 @@ class Fleet:
             self._next_index += 1
             return i
 
-    def _prewarm_shapes(self) -> List[Tuple[int, int, str, str]]:
+    def _devices_for(self, index: int) -> int:
+        """The spec'd (full-size) mesh of worker ``index``: its
+        ``worker_devices`` entry when one exists and is nonzero, else
+        the fleet-wide ``emulate_devices`` default (0 = unsized)."""
+        if 0 <= index < len(self._worker_devices) \
+                and self._worker_devices[index]:
+            return self._worker_devices[index]
+        return self._emulate_devices
+
+    def _prewarm_shapes(self, volumes: bool = True
+                        ) -> List[Tuple[Any, ...]]:
         with self._lock:
             keys = sorted(self._hot_keys,
                           key=lambda k: -self._hot_keys[k])
-        shapes = []
+        shapes: List[Tuple[Any, ...]] = []
         for k in keys[:HOT_KEYS_TRACKED]:
             try:
                 d = plancache.parse_request_key(k)
             except ValueError:
                 continue
-            shapes.append((d["nx"], d["ny"], d["dtype"], d["transform"]))
+            if "nz" in d:
+                # Hot VOLUME shapes go only to mesh-capable workers —
+                # a replacement rebuilds them on whatever mesh it
+                # actually acquired.
+                if volumes:
+                    shapes.append((d["nx"], d["ny"], d["nz"], d["dtype"],
+                                   d["transform"], d["decomp"]))
+            else:
+                shapes.append((d["nx"], d["ny"], d["dtype"],
+                               d["transform"]))
         return shapes
 
     def _spawn(self, index: int, generation: int,
-               prewarm: Optional[List[Tuple[int, int, str, str]]] = None
+               prewarm: Optional[List[Tuple[Any, ...]]] = None
                ) -> _Worker:
         name = f"worker-{index}"
+        full = self._devices_for(index)
+        cut = inject.devloss_cut(index, generation) if full else 0
+        devices = max(1, full - cut) if cut else full
+        resident = (self._resident_spec
+                    if index == self._resident_index else None)
+        if (resident is not None and devices > 1
+                and (devices < full or not resident.get("partitions"))):
+            # Shrink-and-replan (devloss respawn) and the unpinned
+            # default on a sized mesh worker: build the resident at the
+            # partition count the mesh it ACTUALLY acquired can carry,
+            # and let persist restore across the rank-count fingerprint
+            # diff (two-tier contract: allclose + a structured
+            # persist.degraded_restore event, never silent). A spec
+            # that pins ``partitions`` keeps it while the worker is
+            # full-size (strict bit-exact restore).
+            resident = dict(resident, partitions=devices,
+                            allow_mesh_change=True)
+        if devices and devices < full:
+            obs.event("fleet.worker_shrunk", worker=name,
+                      generation=generation, devices=devices,
+                      full_devices=full, lost=cut)
+        prewarm = [t for t in (prewarm or [])
+                   if len(t) == 4 or (full or devices) > 1]
         spec = dict(self._spec_base, name=name, index=index,
-                    generation=generation, prewarm=prewarm or [],
-                    resident=(self._resident_spec
-                              if index == self._resident_index else None))
+                    generation=generation, prewarm=prewarm,
+                    devices=devices, resident=resident)
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(target=_worker_main,
                                  args=(child_conn, spec),
@@ -600,7 +734,7 @@ class Fleet:
         proc.start()
         child_conn.close()
         w = _Worker(name, index, generation, proc, parent_conn,
-                    self.policy)
+                    self.policy, devices=devices, full_devices=full)
         w.reader = threading.Thread(target=self._reader_loop, args=(w,),
                                     daemon=True, name=f"{name}-reader")
         w.reader.start()
@@ -629,6 +763,8 @@ class Fleet:
                 w.state = "ready"
                 w.last_pong = time.monotonic()
                 self.ring.add(w.name)
+                if max(w.devices, w.full_devices) > 1:
+                    self.mesh_ring.add(w.name)
                 if w.generation > 0:
                     self._counts["worker_restarts"] += 1
                 orphans, self._orphans = self._orphans, []
@@ -639,7 +775,9 @@ class Fleet:
         if w.generation > 0:
             obs.metrics.inc("fleet.worker_restarts")
         obs.event("fleet.worker_join", worker=w.name, pid=w.proc.pid,
-                  generation=w.generation, ring=list(self.ring.members()))
+                  generation=w.generation, devices=w.devices,
+                  ring=list(self.ring.members()),
+                  mesh_ring=list(self.mesh_ring.members()))
         for req in orphans:
             self._route(req)
         self._pump(w)
@@ -649,15 +787,36 @@ class Fleet:
     def submit(self, x: Any, transform: str = "r2c",
                direction: str = "forward", *, ny: Optional[int] = None,
                deadline_ms: Optional[float] = None,
+               decomp: Optional[str] = None,
                tenant: str = DEFAULT_TENANT) -> Future:
-        """Admit one request; returns a ``Future``. Raises the structured
-        rejection at submit: ``Overloaded`` (``tenant_quota`` when the
-        tenant is over its weighted share, ``queue_full`` when its
-        worker's router queue is full, ``no_workers`` when the whole
-        ring is down and the parking lot is full) or ``ServerClosed``."""
-        x, nx, ny_, double = normalize_request(x, transform, direction, ny)
-        key = plancache.request_key(nx, ny_, "f64" if double else "f32",
-                                    transform, self.shard)
+        """Admit one request — a 2D image (routed over every worker) or
+        a 3D volume (``fft3d/*`` key, routed over the mesh-capable ring
+        only; ``decomp`` overrides the fleet's ``volume_decomp``
+        default). Returns a ``Future``. Raises the structured rejection
+        at submit: ``Overloaded`` (``tenant_quota`` when the tenant is
+        over its weighted share, ``queue_full`` when its worker's
+        router queue is full, ``no_workers`` when the whole ring is
+        down and the parking lot is full), ``ServerClosed``, or
+        ``ValueError`` for a volume on a fleet with no mesh-capable
+        worker configured."""
+        x, shape, double = normalize_request(x, transform, direction, ny)
+        code = "f64" if double else "f32"
+        if len(shape) == 3:
+            if not self._volume_capable:
+                raise ValueError(
+                    "3D volume request but no mesh-capable worker is "
+                    "configured (give one a worker_devices / "
+                    "emulate_devices mesh of >= 2 devices)")
+            dec = decomp or self.volume_decomp
+            key = plancache.request_key3d(shape[0], shape[1], shape[2],
+                                          code, transform, dec)
+        else:
+            if decomp is not None:
+                raise ValueError("decomp applies to 3D volume requests "
+                                 "only")
+            dec = None
+            key = plancache.request_key(shape[0], shape[1], code,
+                                        transform, self.shard)
         with self._lock:
             if self._state != "running":
                 self._counts["rejected_closed"] += 1
@@ -680,9 +839,9 @@ class Fleet:
         fut: Future = Future()
         fut.trace_id = tid  # type: ignore[attr-defined]
         req = _FleetRequest(x=x, transform=transform, direction=direction,
-                            ny=ny_, key=key, tenant=tenant, deadline=dl,
-                            future=fut, trace_id=tid,
-                            submitted_at=time.monotonic())
+                            ny=shape[-1], key=key, tenant=tenant,
+                            deadline=dl, future=fut, trace_id=tid,
+                            submitted_at=time.monotonic(), decomp=dec)
         try:
             self._route(req, admitting=True)
         except Overloaded as e:
@@ -698,11 +857,12 @@ class Fleet:
     def request(self, x: Any, transform: str = "r2c",
                 direction: str = "forward", *, ny: Optional[int] = None,
                 deadline_ms: Optional[float] = None,
+                decomp: Optional[str] = None,
                 tenant: str = DEFAULT_TENANT,
                 timeout_s: Optional[float] = None) -> np.ndarray:
         """Blocking convenience wrapper over :meth:`submit`."""
         return self.submit(x, transform, direction, ny=ny,
-                           deadline_ms=deadline_ms,
+                           deadline_ms=deadline_ms, decomp=decomp,
                            tenant=tenant).result(timeout_s)
 
     def _tenant_label(self, tenant: str) -> str:
@@ -735,7 +895,7 @@ class Fleet:
         queue bound — a RESUBMITTED request (a worker died under it) is
         never shed here: zero lost requests beats a tidy bound."""
         worker = None
-        owner = self.ring.owner(req.key)
+        owner = self._ring_for(req.key).owner(req.key)
         if owner is not None:
             with self._lock:
                 worker = self._workers.get(owner)
@@ -780,6 +940,15 @@ class Fleet:
             return
         self._pump(worker)
 
+    def _ring_for(self, key: str) -> RendezvousRing:
+        """Capability-aware ring choice: fft3d volume keys hash over the
+        mesh-capable members only; everything else over the full ring.
+        Both rings keep the minimum-movement property WITHIN their
+        capability class (a 2D worker's death never moves a volume
+        key; a mesh worker's death moves only ITS keys in each ring)."""
+        return (self.mesh_ring if key.startswith("fft3d/")
+                else self.ring)
+
     def _pump(self, worker: _Worker) -> None:
         """Wake the worker's dispatcher (cheap, non-blocking — safe on
         admission and reader threads)."""
@@ -819,6 +988,8 @@ class Fleet:
                                    "transform": req.transform,
                                    "direction": req.direction,
                                    "ny": req.ny}
+                        if req.decomp is not None:
+                            payload["decomp"] = req.decomp
                         if req.deadline is not None:
                             payload["deadline_ms"] = \
                                 req.deadline.remaining_ms()
@@ -858,12 +1029,21 @@ class Fleet:
             orphans = len(self._orphans)
         pending = orphans
         inflight = 0
+        capacity = 0.0
         for w in workers:
             with w.lock:
                 pending += len(w.pending)
                 inflight += len(w.inflight)
+            if w.state == "ready":
+                # Capacity-weighted worker count: a worker running at
+                # 4 of its spec'd 8 devices contributes 0.5 — the
+                # controller's signal that "2 workers" may be less than
+                # two workers' worth of capacity.
+                capacity += (w.devices / w.full_devices
+                             if w.full_devices else 1.0)
         obs.metrics.gauge("fleet.pending", pending)
         obs.metrics.gauge("fleet.outstanding", pending + inflight)
+        obs.metrics.gauge("fleet.capacity", round(capacity, 4))
         # Per-tenant quota occupancy, folded through the same bounded
         # label vocabulary as fleet.tenant.shed; a tenant that goes
         # idle keeps its series pinned at 0 rather than freezing at the
@@ -947,7 +1127,8 @@ class Fleet:
         never reused)."""
         lab = obs.metrics.labeled
         for g in ("fleet.worker.queue_depth", "fleet.worker.ema_ms",
-                  "fleet.worker.shed", "fleet.worker.inflight"):
+                  "fleet.worker.shed", "fleet.worker.inflight",
+                  "fleet.worker.devices"):
             obs.metrics.drop_gauge(lab(g, worker=worker.name))
 
     def _fold_worker_stats(self, worker: _Worker) -> None:
@@ -965,6 +1146,12 @@ class Fleet:
                                   worker=worker.name), s["ema_ms"])
         obs.metrics.gauge(lab("fleet.worker.shed", worker=worker.name),
                           s.get("shed", 0))
+        if s.get("devices") is not None:
+            # The capacity surface: after a devloss respawn this series
+            # dips to the shrunken mesh size — the dip CI's mesh chaos
+            # scenario scrapes off /metrics.
+            obs.metrics.gauge(lab("fleet.worker.devices",
+                                  worker=worker.name), s["devices"])
         with worker.lock:
             obs.metrics.gauge(lab("fleet.worker.inflight",
                                   worker=worker.name),
@@ -1023,6 +1210,7 @@ class Fleet:
                 return
             worker.state = "dead"
             self.ring.remove(worker.name)
+            self.mesh_ring.remove(worker.name)
             self._counts["worker_deaths"] += 1
             respawn = self._state == "running"
             if self._workers.get(worker.name) is worker:
@@ -1137,6 +1325,7 @@ class Fleet:
                 return
             worker.state = "draining"
             self.ring.remove(worker.name)
+            self.mesh_ring.remove(worker.name)
         worker.kick.set()  # release the dispatcher thread
         obs.metrics.gauge("fleet.workers", len(self.ring))
         with worker.lock:
@@ -1198,14 +1387,22 @@ class Fleet:
                 wsnap[name] = {
                     "state": w.state, "pid": w.proc.pid,
                     "generation": w.generation,
+                    "devices": w.devices,
+                    "full_devices": w.full_devices,
                     "inflight": len(w.inflight),
                     "pending": len(w.pending),
                     "pending_by_tenant": w.pending.depths(),
                     "last_pong_age_s": round(now - w.last_pong, 3),
                     "stats": dict(w.stats),
                 }
+        # Degraded while any worker runs SHORT of its spec'd mesh (a
+        # devloss replacement serving at reduced capacity) — the fleet
+        # is up, but an operator watching /healthz must see that it is
+        # not whole until a full-size replacement rejoins.
         degraded = (len(self.ring) < len(workers)
-                    or any(s["state"] != "ready" for s in wsnap.values()))
+                    or any(s["state"] != "ready" for s in wsnap.values())
+                    or any(s["devices"] < s["full_devices"]
+                           for s in wsnap.values()))
         status = (state if state != "running"
                   else ("degraded" if degraded else "ok"))
         # The standing resident's progress as folded from its host
@@ -1223,6 +1420,7 @@ class Fleet:
             "uptime_s": round(now - self._started_at, 3),
             "workers": wsnap,
             "ring": list(self.ring.members()),
+            "mesh_ring": list(self.mesh_ring.members()),
             "orphaned": orphans,
             "tenants": self.policy.snapshot(),
             "counters": counts,
@@ -1237,23 +1435,37 @@ class Fleet:
         with self._lock:
             return self._state
 
-    def prewarm(self, shape: Tuple[int, int], dtype: Any = None,
-                transform: str = "r2c", **kw: Any) -> int:
+    def prewarm(self, shape: Tuple[int, ...], dtype: Any = None,
+                transform: str = "r2c", *,
+                decomp: Optional[str] = None, **kw: Any) -> int:
         """Broadcast ``Server.prewarm`` to every ready worker (each only
         serves its own key range, but prewarming all keeps a future
         reroute hot too) and wait for the acknowledgements in parallel;
         returns the total plans NEWLY BUILT across workers (0 when
         every bucket was already hot — same contract as
-        ``Server.prewarm``)."""
-        nx, ny = int(shape[0]), int(shape[1])
+        ``Server.prewarm``). A 3D ``shape`` prewarms the single-shot
+        volume plan on the MESH-CAPABLE workers only (the ones the
+        fft3d ring routes to)."""
         code = ("f64" if dtype is not None
                 and np.dtype(dtype) in (np.float64, np.complex128)
                 else "f32")
-        key = plancache.request_key(nx, ny, code, transform, self.shard)
+        if len(shape) == 3:
+            nx, ny, nz = int(shape[0]), int(shape[1]), int(shape[2])
+            dec = decomp or self.volume_decomp
+            key = plancache.request_key3d(nx, ny, nz, code, transform,
+                                          dec)
+            wire: Tuple[Any, ...] = (nx, ny, nz, code, transform, dec)
+        else:
+            nx, ny = int(shape[0]), int(shape[1])
+            key = plancache.request_key(nx, ny, code, transform,
+                                        self.shard)
+            wire = (nx, ny, code, transform)
         with self._lock:
             self._hot_keys[key] = time.monotonic()
             workers = [w for w in self._workers.values()
-                       if w.state == "ready"]
+                       if w.state == "ready"
+                       and (len(wire) == 4
+                            or max(w.devices, w.full_devices) > 1)]
         # Clear-all THEN send-all: acks arrive concurrently, and a
         # stale ack from a previous (timed-out) prewarm cannot set an
         # event that was cleared after it landed.
@@ -1262,7 +1474,7 @@ class Fleet:
         sent = []
         for w in workers:
             try:
-                w.send(("prewarm", [(nx, ny, code, transform)]))
+                w.send(("prewarm", [wire]))
                 sent.append(w)
             except (OSError, ValueError, BrokenPipeError):
                 continue
@@ -1311,6 +1523,7 @@ class Fleet:
             w.state = "draining"
             w.kick.set()  # release the dispatcher thread
             self.ring.remove(w.name)
+            self.mesh_ring.remove(w.name)
             with w.lock:
                 leftovers += list(w.inflight.values())
                 w.inflight.clear()
@@ -1354,9 +1567,13 @@ def parse_exposition_signals(text: str) -> Dict[str, float]:
     """Extract the controller's input signals from a Prometheus
     exposition body (the literal ``GET /metrics`` surface): live worker
     count, router pending, total shed (router + per-worker), summed
-    worker queue depth, max worker EMA. Unknown/missing series read 0."""
+    worker queue depth, max worker EMA, capacity-weighted worker count
+    (``dfft_fleet_capacity`` — devloss-shrunken workers count
+    fractionally) and total acquired devices. Unknown/missing series
+    read 0."""
     sig = {"workers": 0.0, "pending": 0.0, "shed_total": 0.0,
-           "queue_depth": 0.0, "ema_ms": 0.0}
+           "queue_depth": 0.0, "ema_ms": 0.0, "capacity": 0.0,
+           "devices_total": 0.0}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
@@ -1378,6 +1595,10 @@ def parse_exposition_signals(text: str) -> Dict[str, float]:
             sig["queue_depth"] += value
         elif base in ("dfft_fleet_worker_ema_ms", "dfft_serve_ema_ms"):
             sig["ema_ms"] = max(sig["ema_ms"], value)
+        elif base == "dfft_fleet_capacity":
+            sig["capacity"] = value
+        elif base == "dfft_fleet_worker_devices":
+            sig["devices_total"] += value
     return sig
 
 
@@ -1431,6 +1652,12 @@ class ScaleController:
         shed_delta = (0.0 if self._last_shed is None
                       else max(0.0, shed - self._last_shed))
         workers = int(sig["workers"])
+        # Capacity-weighted worker count (ISSUE 20): a devloss-shrunken
+        # worker counts fractionally, so the queue-pressure threshold
+        # tightens while the fleet runs short — 4-of-8 devices is half
+        # a worker, not a worker. Absent series (pre-scrape) falls back
+        # to the raw count.
+        capacity = sig["capacity"] if sig["capacity"] > 0 else workers
         queue_total = sig["queue_depth"] + sig["pending"]
         cooling = now - self._last_action_at < self.cooldown_s
         if self._last_shed is None or not cooling:
@@ -1452,11 +1679,14 @@ class ScaleController:
         elif shed_delta > 0 and workers < self.max_workers:
             action = "up"
             reason = f"shed grew by {shed_delta:g} since last step"
-        elif (queue_total > self.queue_high * max(workers, 1)
+        elif (queue_total > self.queue_high * max(capacity, 1.0)
                 and workers < self.max_workers):
             action = "up"
             reason = (f"queue depth {queue_total:g} > "
-                      f"{self.queue_high:g}/worker")
+                      f"{self.queue_high:g}/worker"
+                      + (f" (capacity-weighted: {capacity:g} of "
+                         f"{workers} workers)"
+                         if capacity < workers else ""))
         elif (quiet and self._idle_steps >= self.down_idle_steps
                 and workers > self.min_workers):
             action = "down"

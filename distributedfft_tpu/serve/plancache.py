@@ -30,6 +30,9 @@ from typing import Any, Callable, Dict, Tuple
 from .. import obs
 
 
+VOLUME_DECOMPS = ("slab", "pencil")
+
+
 def request_key(nx: int, ny: int, dtype_code: str, transform: str,
                 shard: str) -> str:
     """The COALESCING key: requests agreeing on it may be stacked into one
@@ -39,6 +42,20 @@ def request_key(nx: int, ny: int, dtype_code: str, transform: str,
     return f"fft2d/{nx}x{ny}/{dtype_code}/{transform}/{shard}"
 
 
+def request_key3d(nx: int, ny: int, nz: int, dtype_code: str,
+                  transform: str, decomp: str) -> str:
+    """The 3D-volume request key (ISSUE 20). Same contract as the 2D
+    family — one key per (shape, dtype, transform, decomposition) sharing
+    one plan-cache slot and one circuit breaker — except volumes execute
+    SINGLE-SHOT through the slab/pencil plan families, so there is no
+    batch-bucket axis: the request key IS the cache key (no ``#b``
+    suffix). ``decomp`` names the distributed decomposition the volume
+    runs on (``slab`` | ``pencil``)."""
+    if decomp not in VOLUME_DECOMPS:
+        raise ValueError(f"decomp must be slab|pencil, got {decomp!r}")
+    return f"fft3d/{nx}x{ny}x{nz}/{dtype_code}/{transform}/{decomp}"
+
+
 def cache_key(base_key: str, bucket: int) -> str:
     """One plan-cache slot: the request key plus the batch bucket this
     plan was built for."""
@@ -46,22 +63,34 @@ def cache_key(base_key: str, bucket: int) -> str:
 
 
 def parse_request_key(key: str) -> Dict[str, Any]:
-    """Invert :func:`request_key` (any ``#b<bucket>`` suffix ignored):
-    ``{"nx", "ny", "dtype", "transform", "shard"}``. The fleet uses this
-    to turn the hot-key set it tracked for a dead worker back into the
-    concrete shapes the REPLACEMENT must ``prewarm()`` before rejoining
-    the ring. Raises ``ValueError`` on a malformed key."""
+    """Invert :func:`request_key` / :func:`request_key3d` (any
+    ``#b<bucket>`` suffix ignored). 2D keys parse to ``{"nx", "ny",
+    "dtype", "transform", "shard"}``; 3D keys to ``{"nx", "ny", "nz",
+    "dtype", "transform", "decomp"}``. The fleet uses this to turn the
+    hot-key set it tracked for a dead worker back into the concrete
+    shapes the REPLACEMENT must ``prewarm()`` before rejoining the ring
+    — including a dead MESH worker's hot volume shapes, which the
+    replacement rebuilds on whatever mesh it actually acquired. Raises
+    ``ValueError`` on a malformed key."""
     base = key.split("#", 1)[0]
     parts = base.split("/")
-    if len(parts) != 5 or parts[0] != "fft2d":
+    if len(parts) != 5 or parts[0] not in ("fft2d", "fft3d"):
         raise ValueError(f"not a serve request key: {key!r}")
-    nx, sep, ny = parts[1].partition("x")
-    if not sep or not nx.isdigit() or not ny.isdigit():
+    extents = parts[1].split("x")
+    want = 2 if parts[0] == "fft2d" else 3
+    if len(extents) != want or not all(e.isdigit() for e in extents):
         raise ValueError(f"malformed shape in request key: {key!r}")
     if parts[2] not in ("f32", "f64") or parts[3] not in ("r2c", "c2c"):
         raise ValueError(f"malformed dtype/transform in key: {key!r}")
-    return {"nx": int(nx), "ny": int(ny), "dtype": parts[2],
-            "transform": parts[3], "shard": parts[4]}
+    if parts[0] == "fft2d":
+        return {"nx": int(extents[0]), "ny": int(extents[1]),
+                "dtype": parts[2], "transform": parts[3],
+                "shard": parts[4]}
+    if parts[4] not in VOLUME_DECOMPS:
+        raise ValueError(f"malformed decomp in request key: {key!r}")
+    return {"nx": int(extents[0]), "ny": int(extents[1]),
+            "nz": int(extents[2]), "dtype": parts[2],
+            "transform": parts[3], "decomp": parts[4]}
 
 
 class PlanCache:

@@ -47,6 +47,7 @@ import numpy as np
 
 from .. import obs
 from .. import persist
+from ..parallel import mesh
 
 
 def advance_steps(step_fn: Callable[[Any], Any], state: Any,
@@ -167,7 +168,16 @@ class ResidentSolver:
         if store is not None:
             fp = persist.plan_fingerprint(solver.plan)
             try:
-                sim = store.load(expect_fingerprint=fp)
+                # allow_mesh_change (ISSUE 20): a replacement worker
+                # that came back on FEWER devices rebuilds the solver at
+                # the shrunken partition count and restores across the
+                # rank-count fingerprint diff (persist.degraded_restore
+                # evidence; allclose, not bit-exact) instead of
+                # crash-looping against a checkpoint its mesh can no
+                # longer match bit-for-bit.
+                sim = store.load(expect_fingerprint=fp,
+                                 allow_mesh_change=bool(
+                                     spec.get("allow_mesh_change")))
             except persist.CheckpointMissing:
                 pass  # fresh start — the normal first boot
             except persist.CheckpointUnusable as e:
@@ -225,8 +235,12 @@ class ResidentSolver:
                     break
                 # THE shared stepping idiom (advance_steps): the
                 # production path must be textually the path the
-                # bit-exact tests certify.
-                state = advance_steps(step_jit, self.state, 1)
+                # bit-exact tests certify. DEVICE_LOCK: on a mesh
+                # worker the serving thread executes volume plans on
+                # THIS device set — unserialized collectives from two
+                # threads deadlock XLA's in-process rendezvous.
+                with mesh.DEVICE_LOCK:
+                    state = advance_steps(step_jit, self.state, 1)
                 with self._lock:
                     self.state = state
                     self.step += 1

@@ -2,8 +2,13 @@
 
 ``Server`` is the interactive-traffic successor of the reference's
 batch-era L6 launcher (``launch.py`` + JSON job specs): one resident
-process that keeps compiled plans hot and answers 2D FFT requests under
-an explicit robustness envelope. The request path:
+process that keeps compiled plans hot and answers 2D image AND 3D
+volume FFT requests under an explicit robustness envelope. Images
+coalesce into batched2d stacked execution; volumes (ISSUE 20) execute
+SINGLE-SHOT through the slab/pencil plan families — no coalescing yet
+(those families have no batch axis to stack along), but the same
+admission, deadline, circuit-breaker and drain envelope applies. The
+request path:
 
 1. **Admission** (``submit``; caller's thread, microseconds): a closed or
    draining server rejects with :class:`ServerClosed`; a key whose
@@ -51,6 +56,7 @@ import numpy as np
 
 from .. import obs
 from .. import params as pm
+from ..parallel import mesh as pmesh
 from ..resilience import deadline as dl
 from ..resilience import inject
 from ..resilience.circuit import CircuitBreaker
@@ -92,17 +98,27 @@ class _Request:
     future: Future
     submitted_at: float
     trace_id: str = ""
+    nz: Optional[int] = None        # 3D volumes only (ISSUE 20)
+    decomp: Optional[str] = None    # slab | pencil, volumes only
+
+    @property
+    def volume(self) -> bool:
+        return self.nz is not None
 
     def coalesce_key(self) -> Tuple[str, str]:
         return (self.base_key, self.direction)
 
 
 def normalize_request(x: Any, transform: str, direction: str,
-                      ny: Optional[int]) -> Tuple[np.ndarray, int, int, bool]:
-    """Validate one request payload; returns ``(x, nx, ny, double)`` with
-    ``ny`` the LOGICAL real width (needed to key/construct the plan — a
-    spectral r2c payload alone cannot distinguish even/odd ny, so inverse
-    r2c callers may pass it; default assumes even). Module-level so the
+                      ny: Optional[int]
+                      ) -> Tuple[np.ndarray, Tuple[int, ...], bool]:
+    """Validate one request payload; returns ``(x, shape, double)`` with
+    ``shape`` the LOGICAL extents — ``(nx, ny)`` for a 2D image,
+    ``(nx, ny, nz)`` for a 3D volume (ISSUE 20). ``ny`` names the
+    logical extent of the HALVED LAST axis (y for images, z for
+    volumes), needed to key/construct the plan — a spectral r2c payload
+    alone cannot distinguish an even/odd last extent, so inverse r2c
+    callers may pass it; default assumes even. Module-level so the
     fleet router (``fleet.py``) validates and keys requests with EXACTLY
     the vocabulary each worker's ``Server`` will use."""
     if transform not in ("r2c", "c2c"):
@@ -111,11 +127,12 @@ def normalize_request(x: Any, transform: str, direction: str,
         raise ValueError(
             f"direction must be forward|inverse, got {direction!r}")
     x = np.asarray(x)
-    if x.ndim != 2:
+    if x.ndim not in (2, 3):
         raise ValueError(
-            f"serve requests are single 2D images, got shape {x.shape} "
-            "(batching is the server's job — submit images "
-            "concurrently and they coalesce)")
+            f"serve requests are single 2D images or 3D volumes, got "
+            f"shape {x.shape} (batching is the server's job — submit "
+            "images concurrently and they coalesce; volumes execute "
+            "single-shot)")
     complex_in = (transform == "c2c") or (direction == "inverse")
     if complex_in != np.iscomplexobj(x):
         raise ValueError(
@@ -124,18 +141,18 @@ def normalize_request(x: Any, transform: str, direction: str,
             f"dtype {x.dtype}")
     double = x.dtype in (np.float64, np.complex128)
     if transform == "c2c" or direction == "forward":
-        nx_, ny_ = int(x.shape[0]), int(x.shape[1])
-        if ny is not None and int(ny) != ny_:
+        shape = tuple(int(s) for s in x.shape)
+        if ny is not None and int(ny) != shape[-1]:
             raise ValueError(f"ny {ny} disagrees with payload {x.shape}")
-        return x, nx_, ny_, double
-    # inverse r2c: payload is (nx, ny//2 + 1) spectral
-    nx_, nys = int(x.shape[0]), int(x.shape[1])
-    ny_ = int(ny) if ny is not None else 2 * (nys - 1)
-    if ny_ // 2 + 1 != nys:
+        return x, shape, double
+    # inverse r2c: the LAST axis is spectral (n_last//2 + 1)
+    ns = int(x.shape[-1])
+    n_last = int(ny) if ny is not None else 2 * (ns - 1)
+    if n_last // 2 + 1 != ns:
         raise ValueError(
-            f"ny {ny_} inconsistent with spectral payload {x.shape} "
-            f"(expects ny//2+1 == {nys})")
-    return x, nx_, ny_, double
+            f"ny {n_last} inconsistent with spectral payload {x.shape} "
+            f"(expects ny//2+1 == {ns})")
+    return x, tuple(int(s) for s in x.shape[:-1]) + (n_last,), double
 
 
 _EMA_ALPHA = 0.2
@@ -192,7 +209,10 @@ class Server:
     per request from the payload dtype). ``shard`` picks the batched2d
     decomposition: ``"batch"`` (default — embarrassingly parallel,
     coalescing-friendly) or ``"x"`` (slab-style with a real exchange —
-    the decomposition the chaos drill targets with wire faults)."""
+    the decomposition the chaos drill targets with wire faults).
+    ``volume_decomp`` is the default 3D decomposition (``slab`` |
+    ``pencil``) a volume request executes on when it does not name one
+    itself."""
 
     def __init__(self, partition: Optional[pm.SlabPartition] = None,
                  config: Optional[pm.Config] = None, mesh: Any = None,
@@ -200,15 +220,19 @@ class Server:
                  latency_budget_ms: float = 1000.0, max_coalesce: int = 8,
                  batch_chunk: Optional[int] = 1, cache_capacity: int = 8,
                  circuit_k: int = 3, circuit_cooldown_s: float = 5.0,
-                 name: str = "dfft-serve"):
+                 volume_decomp: str = "slab", name: str = "dfft-serve"):
         if shard not in ("batch", "x"):
             raise ValueError(f"shard must be 'batch' or 'x', got {shard!r}")
+        if volume_decomp not in plancache.VOLUME_DECOMPS:
+            raise ValueError(
+                f"volume_decomp must be slab|pencil, got {volume_decomp!r}")
         if max_queue < 1 or max_coalesce < 1:
             raise ValueError("max_queue and max_coalesce must be >= 1")
         self.partition = partition or pm.SlabPartition(1)
         self.config = config or pm.Config()
         self.mesh = mesh
         self.shard = shard
+        self.volume_decomp = volume_decomp
         self.max_queue = int(max_queue)
         self.latency_budget_ms = float(latency_budget_ms)
         self.max_coalesce = int(max_coalesce)
@@ -251,7 +275,8 @@ class Server:
     # -- admission ---------------------------------------------------------
 
     def _normalize(self, x: Any, transform: str, direction: str,
-                   ny: Optional[int]) -> Tuple[np.ndarray, int, int, bool]:
+                   ny: Optional[int]
+                   ) -> Tuple[np.ndarray, Tuple[int, ...], bool]:
         return normalize_request(x, transform, direction, ny)
 
     def _breaker(self, key: str) -> CircuitBreaker:
@@ -305,15 +330,30 @@ class Server:
 
     def submit(self, x: Any, transform: str = "r2c",
                direction: str = "forward", *, ny: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> Future:
-        """Admit one 2D FFT request; returns a ``Future`` resolving to the
-        result array, or raising the structured rejection
-        (:class:`Overloaded` / ``CircuitOpen`` / :class:`ServerClosed` /
-        ``DeadlineExceeded``). Admission itself raises — a rejected
-        request never occupies the queue."""
-        x, nx, ny_, double = self._normalize(x, transform, direction, ny)
-        key = plancache.request_key(
-            nx, ny_, "f64" if double else "f32", transform, self.shard)
+               deadline_ms: Optional[float] = None,
+               decomp: Optional[str] = None) -> Future:
+        """Admit one FFT request — a single 2D image (coalescing-
+        eligible) or a 3D volume (ISSUE 20: keyed ``fft3d/...``, executed
+        SINGLE-SHOT through the slab/pencil plan families; ``decomp``
+        overrides the server's ``volume_decomp`` default). Returns a
+        ``Future`` resolving to the result array, or raising the
+        structured rejection (:class:`Overloaded` / ``CircuitOpen`` /
+        :class:`ServerClosed` / ``DeadlineExceeded``). Admission itself
+        raises — a rejected request never occupies the queue."""
+        x, shape, double = self._normalize(x, transform, direction, ny)
+        code = "f64" if double else "f32"
+        if len(shape) == 3:
+            dec = decomp or self.volume_decomp
+            key = plancache.request_key3d(
+                shape[0], shape[1], shape[2], code, transform, dec)
+            nz: Optional[int] = shape[2]
+        else:
+            if decomp is not None:
+                raise ValueError("decomp applies to 3D volume requests "
+                                 f"only, got a {len(shape)}D payload")
+            key = plancache.request_key(
+                shape[0], shape[1], code, transform, self.shard)
+            dec, nz = None, None
         deadline = (Deadline.after_ms(deadline_ms)
                     if deadline_ms is not None else None)
         with self._lock:
@@ -342,10 +382,12 @@ class Server:
                                  deadline.remaining_ms())
             fut: Future = Future()
             tid = _new_trace_id()
-            req = _Request(x=x, nx=nx, ny=ny_, transform=transform,
-                           double=double, direction=direction,
-                           base_key=key, deadline=deadline, future=fut,
-                           submitted_at=time.monotonic(), trace_id=tid)
+            req = _Request(x=x, nx=shape[0], ny=shape[1],
+                           transform=transform, double=double,
+                           direction=direction, base_key=key,
+                           deadline=deadline, future=fut,
+                           submitted_at=time.monotonic(), trace_id=tid,
+                           nz=nz, decomp=dec)
             # The id rides the future so callers (the HTTP front end's
             # X-DFFT-Trace header) can hand it back to the client.
             fut.trace_id = tid  # type: ignore[attr-defined]
@@ -362,10 +404,12 @@ class Server:
     def request(self, x: Any, transform: str = "r2c",
                 direction: str = "forward", *, ny: Optional[int] = None,
                 deadline_ms: Optional[float] = None,
+                decomp: Optional[str] = None,
                 timeout_s: Optional[float] = None) -> np.ndarray:
         """Blocking convenience wrapper over :meth:`submit`."""
         return self.submit(x, transform, direction, ny=ny,
-                           deadline_ms=deadline_ms).result(timeout_s)
+                           deadline_ms=deadline_ms,
+                           decomp=decomp).result(timeout_s)
 
     # -- worker ------------------------------------------------------------
 
@@ -375,7 +419,11 @@ class Server:
         within the key), up to ``max_coalesce``."""
         head = self._pending.pop(0)
         batch = [head]
-        if self.max_coalesce > 1:
+        # Volumes execute SINGLE-SHOT (no coalescing yet, documented):
+        # the slab/pencil plan families have no batch axis to stack
+        # along, so a volume head takes the worker alone and every other
+        # queued request stays put.
+        if self.max_coalesce > 1 and not head.volume:
             keep: List[_Request] = []
             for r in self._pending:
                 if (len(batch) < self.max_coalesce
@@ -465,16 +513,45 @@ class Server:
         return self._make_plan(req.nx, req.ny, req.transform, req.double,
                                bucket)
 
-    def prewarm(self, shape: Tuple[int, int], dtype: Any = None,
+    def _make_volume_plan(self, nx: int, ny: int, nz: int, transform: str,
+                          double: bool, decomp: str) -> Any:
+        """Build the single-shot 3D plan a volume request executes on:
+        the server's partition width spread over the slab x-axis, or its
+        most-square (p1, p2) pencil factorization. The plan constructs
+        its own mesh (``make_slab_mesh``/``make_pencil_mesh``) from the
+        process's visible devices — the server's 2D ``mesh`` (if any)
+        has the wrong axis names for the 3D families."""
+        from ..models.pencil import PencilFFTPlan
+        from ..models.slab import SlabFFTPlan
+        from ..parallel.mesh import best_pencil_grid
+        cfg = dataclasses.replace(self.config, double_prec=double)
+        g = pm.GlobalSize(nx, ny, nz)
+        p = self.partition.p
+        if decomp == "slab":
+            return SlabFFTPlan(g, pm.SlabPartition(p), cfg,
+                               transform=transform)
+        p1, p2 = best_pencil_grid(p)
+        return PencilFFTPlan(g, pm.PencilPartition(p1, p2), cfg,
+                             transform=transform)
+
+    def prewarm(self, shape: Tuple[int, ...], dtype: Any = None,
                 transform: str = "r2c", *,
-                directions: Tuple[str, ...] = ("forward",)) -> int:
+                directions: Tuple[str, ...] = ("forward",),
+                decomp: Optional[str] = None) -> int:
         """Build + compile the plan-cache slots one traffic shape needs —
-        every power-of-two coalescing bucket up to ``max_coalesce`` —
-        BEFORE traffic arrives, so no request ever stalls behind a lazy
-        bucket compile (a rolling restart calls this between bind and
-        ready). Runs in the caller's thread against the shared cache;
-        call it before serving traffic, not during. Returns the number of
-        plans newly built."""
+        every power-of-two coalescing bucket up to ``max_coalesce`` for a
+        2D image shape, the ONE single-shot slab/pencil plan for a 3D
+        volume shape — BEFORE traffic arrives, so no request ever stalls
+        behind a lazy compile (a rolling restart calls this between bind
+        and ready; a fleet replacement calls it with the dead worker's
+        hot shapes, including volumes rebuilt on whatever mesh it
+        actually acquired). Runs in the caller's thread against the
+        shared cache; call it before serving traffic, not during.
+        Returns the number of plans newly built."""
+        if len(shape) == 3:
+            return self._prewarm_volume(shape, dtype, transform,
+                                        directions=directions,
+                                        decomp=decomp)
         nx, ny = int(shape[0]), int(shape[1])
         dt = np.dtype(dtype) if dtype is not None else np.dtype(np.float32)
         double = dt in (np.float64, np.complex128)
@@ -498,21 +575,59 @@ class Server:
             else:
                 x = np.zeros((b, nx, ny),
                              np.float64 if double else np.float32)
-            if "forward" in directions:
-                np.asarray(plan.exec_forward(x))
-            if "inverse" in directions:
-                if transform == "c2c":
-                    np.asarray(plan.exec_inverse(
-                        np.zeros((b, nx, ny),
-                                 np.complex128 if double else np.complex64)))
-                else:
-                    np.asarray(plan.exec_inverse(
-                        np.zeros((b, nx, ny // 2 + 1),
-                                 np.complex128 if double else np.complex64)))
+            # DEVICE_LOCK: a fleet replacement prewarms the dead
+            # worker's hot shapes AFTER its restored resident already
+            # steps on another thread — same mesh, same rendezvous
+            # hazard as _execute.
+            with pmesh.DEVICE_LOCK:
+                if "forward" in directions:
+                    np.asarray(plan.exec_forward(x))
+                if "inverse" in directions:
+                    if transform == "c2c":
+                        np.asarray(plan.exec_inverse(
+                            np.zeros((b, nx, ny),
+                                     np.complex128 if double
+                                     else np.complex64)))
+                    else:
+                        np.asarray(plan.exec_inverse(
+                            np.zeros((b, nx, ny // 2 + 1),
+                                     np.complex128 if double
+                                     else np.complex64)))
             b <<= 1
         obs.event("serve.prewarm", key=key, built=built,
                   directions=list(directions))
         return built
+
+    def _prewarm_volume(self, shape: Tuple[int, ...], dtype: Any,
+                        transform: str, *, directions: Tuple[str, ...],
+                        decomp: Optional[str]) -> int:
+        nx, ny, nz = (int(s) for s in shape)
+        dt = np.dtype(dtype) if dtype is not None else np.dtype(np.float32)
+        double = dt in (np.float64, np.complex128)
+        dec = decomp or self.volume_decomp
+        key = plancache.request_key3d(nx, ny, nz,
+                                      "f64" if double else "f32",
+                                      transform, dec)
+        plan, hit = self.cache.get_or_build(
+            key, lambda: self._make_volume_plan(nx, ny, nz, transform,
+                                                double, dec))
+        cdt = np.complex128 if double else np.complex64
+        rdt = np.float64 if double else np.float32
+        # DEVICE_LOCK: see prewarm — the replacement-worker path runs
+        # this concurrently with a stepping resident on the same mesh.
+        with pmesh.DEVICE_LOCK:
+            if "forward" in directions:
+                x = np.zeros((nx, ny, nz),
+                             cdt if transform == "c2c" else rdt)
+                np.asarray(plan.exec_c2c(x) if transform == "c2c"
+                           else plan.exec_r2c(x))
+            if "inverse" in directions:
+                c = np.zeros(plan.output_shape, cdt)
+                np.asarray(plan.exec_c2c_inv(c) if transform == "c2c"
+                           else plan.exec_c2r(c))
+        obs.event("serve.prewarm", key=key, built=0 if hit else 1,
+                  directions=list(directions))
+        return 0 if hit else 1
 
     def _execute(self, batch: List[_Request]) -> None:
         key = batch[0].base_key
@@ -555,16 +670,30 @@ class Server:
             obs.metrics.observe("serve.queue_wait_ms",
                                 (now_mono - r.submitted_at) * 1e3)
         t0 = time.perf_counter()
+        head = alive[0]
+        volume = head.volume
         try:
             n = len(alive)
-            bucket = plancache.bucket_for(n, self.max_coalesce)
-            ckey = plancache.cache_key(key, bucket)
-            plan, hit = self.cache.get_or_build(
-                ckey, lambda: self._build_plan(alive[0], bucket))
-            stack = np.stack([r.x for r in alive])
-            if bucket > n:
-                pad = np.zeros((bucket - n,) + stack.shape[1:], stack.dtype)
-                stack = np.concatenate([stack, pad])
+            if volume:
+                # Single-shot: no bucket axis, the request key IS the
+                # cache slot, and the payload executes unstacked through
+                # the slab/pencil family.
+                bucket, ckey = 1, key
+                plan, hit = self.cache.get_or_build(
+                    key, lambda: self._make_volume_plan(
+                        head.nx, head.ny, head.nz, head.transform,
+                        head.double, head.decomp))
+                stack = head.x
+            else:
+                bucket = plancache.bucket_for(n, self.max_coalesce)
+                ckey = plancache.cache_key(key, bucket)
+                plan, hit = self.cache.get_or_build(
+                    ckey, lambda: self._build_plan(alive[0], bucket))
+                stack = np.stack([r.x for r in alive])
+                if bucket > n:
+                    pad = np.zeros((bucket - n,) + stack.shape[1:],
+                                   stack.dtype)
+                    stack = np.concatenate([stack, pad])
             # The ladder scope gets the LOOSEST member deadline: expiry
             # is enforced per request before and after execution, so the
             # ambient deadline exists only to bound fallback retries —
@@ -576,16 +705,36 @@ class Server:
             if all(r.deadline is not None for r in alive):
                 batch_dl = max((r.deadline for r in alive),
                                key=lambda d: d.expires_at)
-            head = alive[0]
-            with obs.span("serve.execute", key=ckey, n=n, bucket=bucket,
-                          direction=head.direction,
-                          traces=[r.trace_id for r in alive]), \
+            # DEVICE_LOCK: a resident solver stepping on its own thread
+            # shares this worker's device mesh — interleaved collectives
+            # from two threads deadlock XLA's in-process rendezvous
+            # (see parallel.mesh.DEVICE_LOCK). Lock wait counts into the
+            # request's measured latency: callers really do queue behind
+            # the resident's current step.
+            with pmesh.DEVICE_LOCK, \
+                    obs.span("serve.execute", key=ckey, n=n, bucket=bucket,
+                             direction=head.direction,
+                             traces=[r.trace_id for r in alive]), \
                     dl.scope(batch_dl):
-                if head.direction == "forward":
+                fwd = head.direction == "forward"
+                if volume:
+                    if head.transform == "r2c":
+                        out = (plan.exec_r2c(stack) if fwd
+                               else plan.exec_c2r(stack))
+                    else:
+                        out = (plan.exec_c2c(stack) if fwd
+                               else plan.exec_c2c_inv(stack))
+                    # crop_* materialize to logical host arrays: the
+                    # latency is real, and the padded lanes never leave
+                    # the server.
+                    res = (plan.crop_spectral(out) if fwd
+                           else plan.crop_real(out))
+                elif fwd:
                     out = plan.exec_forward(stack)
+                    res = np.asarray(out)  # materialize
                 else:
                     out = plan.exec_inverse(stack)
-                res = np.asarray(out)  # materialize: the latency is real
+                    res = np.asarray(out)
         except Exception as err:  # noqa: BLE001 — every failure is a verdict
             opened = breaker.record_failure(err)
             if opened:
@@ -614,10 +763,11 @@ class Server:
             # batches are build-dominated and would swamp the histogram
             # the same way they would corrupt the shed EMA.
             obs.metrics.observe("serve.exec_ms", ms / n)
-        if head.direction == "forward":
-            res = res[:n, :head.nx, :plan._ny_spec]
-        else:
-            res = res[:n, :head.nx, :head.ny]
+        if not volume:
+            if head.direction == "forward":
+                res = res[:n, :head.nx, :plan._ny_spec]
+            else:
+                res = res[:n, :head.nx, :head.ny]
         with self._lock:
             if hit:
                 # Only warm (cache-hit) executions feed the queue-delay
@@ -650,7 +800,8 @@ class Server:
                                     (done_mono - r.submitted_at) * 1e3)
                 obs.event("serve.reply", trace=r.trace_id, outcome="ok",
                           coalesced_n=n)
-                settle_future(r.future, result=np.array(res[i]))
+                settle_future(r.future,
+                              result=res if volume else np.array(res[i]))
 
     # -- resident solver tenant (ISSUE 14) ---------------------------------
 

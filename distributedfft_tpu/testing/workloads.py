@@ -155,9 +155,10 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
     because the server is slow — the honest way to measure a serving
     system under saturation (a closed loop self-throttles and hides the
     latency cliff). Traffic mixes uniformly over ``shapes``
-    (``(nx, ny)`` pairs), ``dtypes`` (``"f32"``/``"f64"``) and
-    ``transforms`` (``"r2c"``/``"c2c"``), seed-keyed so a chaos run is
-    reproducible.
+    (``(nx, ny)`` image pairs and/or ``(nx, ny, nz)`` volume triples —
+    ISSUE 20; volume cells need a mesh-capable server/fleet), ``dtypes``
+    (``"f32"``/``"f64"``) and ``transforms`` (``"r2c"``/``"c2c"``),
+    seed-keyed so a chaos run is reproducible.
 
     Every submission outcome is tallied: completed requests contribute
     their end-to-end latency (submit -> result materialized), rejections
@@ -183,11 +184,11 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
     if (duration_s is None) == (n_requests is None):
         raise ValueError("pass exactly one of duration_s / n_requests")
     rng = np.random.default_rng(seed)
-    cells = [(int(nx), int(ny), d, t) for nx, ny in shapes
+    cells = [(tuple(int(n) for n in shape), d, t) for shape in shapes
              for d in dtypes for t in transforms]
 
-    def _payload(nx, ny, d, t):
-        real = rng.random((nx, ny),
+    def _payload(shape, d, t):
+        real = rng.random(shape,
                           dtype=np.float64 if d == "f64" else np.float32)
         if t == "c2c":
             return real.astype(np.complex128 if d == "f64"
@@ -205,17 +206,17 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
     cache_cap = getattr(getattr(server, "cache", None), "capacity", None)
     full_prewarm = (cache_cap is None
                     or len(cells) * buckets_per_cell <= cache_cap)
-    for nx, ny, d, t in (cells if warmup else []):
+    for shape, d, t in (cells if warmup else []):
         if full_prewarm:
             try:
-                server.prewarm((nx, ny),
+                server.prewarm(shape,
                                dtype="float64" if d == "f64" else "float32",
                                transform=t)
             except Exception:  # noqa: BLE001 — warmup failures are the
                 pass           # run's own evidence (chaos drills inject)
         for _ in range(warmup):
             try:
-                server.request(_payload(nx, ny, d, t), t)
+                server.request(_payload(shape, d, t), t)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -275,7 +276,7 @@ def serve_load(server, *, rate_hz: float, duration_s: float | None = None,
             kw = {"deadline_ms": deadline_ms}
             if tn is not None:
                 kw["tenant"] = tn
-            fut = server.submit(x, cell[3], **kw)
+            fut = server.submit(x, cell[2], **kw)
         except Exception as e:  # noqa: BLE001 — classify the rejection
             _tally(_classify(e), tn)
             continue

@@ -38,7 +38,7 @@ from distributedfft_tpu.resilience import inject
 from distributedfft_tpu.resilience.deadline import DeadlineExceeded
 from distributedfft_tpu.serve import (Fleet, Overloaded, ScaleController,
                                       ServerClosed, parse_request_key,
-                                      request_key)
+                                      request_key, request_key3d)
 from distributedfft_tpu.serve.fleet import parse_exposition_signals
 from distributedfft_tpu.serve.router import (FairQueue, RendezvousRing,
                                              TenantPolicy)
@@ -170,8 +170,21 @@ def test_parse_request_key_roundtrip():
         "nx": 48, "ny": 36, "dtype": "f64", "transform": "c2c",
         "shard": "x"}
     assert parse_request_key(key + "#b4")["nx"] == 48
+    # the 3D volume family (ISSUE 20): no bucket suffix ever — the
+    # request key IS the cache key (volumes execute single-shot)
+    vkey = request_key3d(64, 48, 32, "f32", "r2c", "slab")
+    assert vkey == "fft3d/64x48x32/f32/r2c/slab"
+    assert parse_request_key(vkey) == {
+        "nx": 64, "ny": 48, "nz": 32, "dtype": "f32",
+        "transform": "r2c", "decomp": "slab"}
+    p = parse_request_key(request_key3d(16, 16, 16, "f64", "c2c",
+                                        "pencil"))
+    assert (p["dtype"], p["decomp"]) == ("f64", "pencil")
     for bad in ("fft2d/axb/f32/r2c/batch", "nope/16x16/f32/r2c/batch",
-                "fft2d/16x16/f16/r2c/batch", "fft2d/16x16/f32/dct/batch"):
+                "fft2d/16x16/f16/r2c/batch", "fft2d/16x16/f32/dct/batch",
+                "fft3d/16x16/f32/r2c/slab", "fft3d/16x16x16/f32/r2c/tile",
+                "fft3d/16x16xq/f32/r2c/slab",
+                "fft3d/16x16x16/f16/r2c/slab"):
         with pytest.raises(ValueError):
             parse_request_key(bad)
 
@@ -193,12 +206,19 @@ def _expo(workers, shed, queue, pending=0, ema=5.0):
 def test_parse_exposition_signals():
     sig = parse_exposition_signals(_expo(3, 7, 4, pending=2, ema=9.5))
     assert sig == {"workers": 3.0, "pending": 2.0, "shed_total": 7.0,
-                   "queue_depth": 4.0, "ema_ms": 9.5}
-    # labeled series sum; garbage lines ignored
+                   "queue_depth": 4.0, "ema_ms": 9.5, "capacity": 0.0,
+                   "devices_total": 0.0}
+    # labeled series sum; garbage lines ignored; the capacity signals
+    # (ISSUE 20) ride the same scrape
     text = (_expo(2, 1, 4)
             + 'dfft_fleet_worker_queue_depth{worker="worker-1"} 6\n'
+            + "dfft_fleet_capacity 2.5\n"
+            + 'dfft_fleet_worker_devices{worker="worker-0"} 4\n'
+            + 'dfft_fleet_worker_devices{worker="worker-1"} 1\n'
             + "# HELP nonsense\nnot a sample line at all\n")
-    assert parse_exposition_signals(text)["queue_depth"] == 10.0
+    sig = parse_exposition_signals(text)
+    assert sig["queue_depth"] == 10.0
+    assert sig["capacity"] == 2.5 and sig["devices_total"] == 5.0
 
 
 class _FakeFleet:
@@ -247,6 +267,26 @@ def test_scale_controller_policy_and_audit_trail(tmp_path, monkeypatch):
     assert dumps, "scale_decision must trigger a flight-recorder dump"
     assert flightrec.validate_dump_file(
         os.path.join(tmp_path, dumps[0])) >= 0
+
+
+def test_scale_controller_capacity_weighted_threshold():
+    """ISSUE 20: the queue-pressure threshold weighs CAPACITY, not the
+    raw worker count — a devloss-shrunken fleet (capacity 1.25 of 2
+    workers) scales up under a queue a full-capacity fleet absorbs."""
+    fleet = _FakeFleet()
+    feed = {"text": _expo(2, 0, 0)}
+    ctl = ScaleController(fleet, 1, 4, cooldown_s=0.0, queue_high=4.0,
+                          render=lambda: feed["text"])
+    assert ctl.step()["action"] == "hold"  # baseline
+    # queue 6 <= 4/worker x 2 workers at full capacity: hold
+    feed["text"] = _expo(2, 0, 6) + "dfft_fleet_capacity 2\n"
+    assert ctl.step()["action"] == "hold"
+    # same queue, fleet running short: 6 > 4 x 1.25 -> up, and the
+    # audit record says WHY in capacity terms
+    feed["text"] = _expo(2, 0, 6) + "dfft_fleet_capacity 1.25\n"
+    rec = ctl.step()
+    assert rec["action"] == "up"
+    assert "capacity-weighted" in rec["reason"]
 
 
 def test_scale_controller_cooldown_and_validation():
@@ -298,6 +338,50 @@ def test_stub_fleet_roundtrip_health_and_close():
     assert f.state == "stopped"
     with pytest.raises(ServerClosed):
         f.submit(_img((16, 16)))
+
+
+def test_fleet_volume_capability_routing():
+    """ISSUE 20: ``fft3d/*`` keys route over the MESH ring (workers that
+    acquired devices) only — no volume key ever lands on a 2D worker —
+    while 2D keys keep the full ring; volumes round-trip through the
+    capable worker; a fleet with NO mesh-capable worker refuses volumes
+    loudly at submit."""
+    with _stub_fleet(3, worker_devices=[8, 0, 0]) as f:
+        v = _img((16, 16, 16))
+        spec = f.request(v, "r2c", timeout_s=60)
+        np.testing.assert_allclose(spec, np.fft.rfftn(v), rtol=1e-4,
+                                   atol=1e-3)
+        back = f.request(np.asarray(spec), "r2c", "inverse", ny=16,
+                         timeout_s=60)
+        np.testing.assert_allclose(back / v.size, v, atol=1e-4)
+        z = _img((12, 12, 12)).astype(np.complex64)
+        np.testing.assert_allclose(f.request(z, "c2c", timeout_s=60),
+                                   np.fft.fftn(z), rtol=1e-3, atol=1e-3)
+        h = f.health()
+        assert h["mesh_ring"] == ["worker-0"]
+        assert sorted(h["ring"]) == ["worker-0", "worker-1", "worker-2"]
+        devs = {w: (s["devices"], s["full_devices"])
+                for w, s in h["workers"].items()}
+        # 0 = unsized spec (falls back to --emulate-devices); the sized
+        # mesh worker carries its acquired/full counts
+        assert devs == {"worker-0": (8, 8), "worker-1": (0, 0),
+                        "worker-2": (0, 0)}
+        # the partition, over a spread of keys: EVERY volume key owns to
+        # a mesh member; 2D keys rendezvous over the whole ring
+        for n in (16, 24, 32, 48, 64, 96, 128, 256):
+            key = request_key3d(n, n, n, "f32", "r2c", "slab")
+            assert f._ring_for(key) is f.mesh_ring
+            assert f.mesh_ring.owner(key) == "worker-0"
+        assert f._ring_for(
+            request_key(16, 16, "f32", "r2c", "batch")) is f.ring
+        # decomp is a volume-only axis; 2D payloads refuse it loudly
+        with pytest.raises(ValueError):
+            f.submit(_img((16, 16)), decomp="slab")
+    # no mesh-capable worker anywhere: volumes are a config error, not
+    # a routing black hole
+    with _stub_fleet(2) as f2:
+        with pytest.raises(ValueError):
+            f2.submit(_img((8, 8, 8)))
 
 
 def test_fleet_worker_crash_recovery_zero_lost(tmp_path, monkeypatch):

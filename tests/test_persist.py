@@ -205,6 +205,75 @@ def test_fingerprint_mismatch_refused_without_fallback(tmp_path):
     assert d["fingerprint_verdict"].startswith("MISMATCH")
 
 
+def test_mesh_change_two_tier_restore_contract(tmp_path):
+    """ISSUE 20: ``allow_mesh_change`` waives EXACTLY the mesh-bound
+    fingerprint fields (ranks/sequence/variant) — recorded as a
+    structured ``persist.degraded_restore`` event + counter, never
+    silently — while any numerics-bearing diff still refuses, and the
+    default (False) refuses even the mesh-only diff."""
+    from distributedfft_tpu.persist import MESH_CHANGE_FIELDS
+    assert MESH_CHANGE_FIELDS == {"ranks", "sequence", "variant"}
+    fp8 = {"plan": "SlabFFTPlan", "shape": [18, 18, 18], "ranks": 8,
+           "variant": "zy_then_x", "wire": "native"}
+    store = CheckpointStore(str(tmp_path / "ck"))
+    store.save(_state(step=3, fp=fp8))
+    fp4 = dict(fp8, ranks=4)
+    with pytest.raises(CheckpointMismatch) as ei:   # tier 1: the default
+        store.load(expect_fingerprint=fp4)          # stays a refusal
+    assert set(ei.value.diffs) == {"ranks"}
+    c0 = obs.metrics.counter_value("persist.degraded_restores")
+    obs.enable(str(tmp_path / "ev"))
+    try:
+        sim = store.load(expect_fingerprint=fp4, allow_mesh_change=True)
+    finally:
+        obs.reset_enablement()
+    assert sim.step == 3
+    assert obs.metrics.counter_value("persist.degraded_restores") == c0 + 1
+    names = set()
+    for fn in os.listdir(tmp_path / "ev"):
+        with open(tmp_path / "ev" / fn) as f:
+            names |= {json.loads(ln)["name"] for ln in f if ln.strip()}
+    assert "persist.degraded_restore" in names
+    # tier 2: the SAME-mesh load stays clean — no degraded evidence
+    assert store.load(expect_fingerprint=fp8).step == 3
+    assert obs.metrics.counter_value("persist.degraded_restores") == c0 + 1
+    # a numerics-bearing diff refuses even with the waiver (and a mixed
+    # diff — mesh fields plus a real one — refuses with the FULL diff)
+    with pytest.raises(CheckpointMismatch) as ei:
+        store.load(expect_fingerprint=dict(fp4, wire="bf16"),
+                   allow_mesh_change=True)
+    assert set(ei.value.diffs) == {"ranks", "wire"}
+
+
+def test_fit_padded_crops_and_repads_split_axis():
+    """ISSUE 20: restoring across a mesh change re-fits the captured
+    host array to the NEW plan's padded spectral shape — the logical
+    region is preserved verbatim, new pad lanes are exact zeros, and an
+    unchanged shape passes through untouched (the bit-exact path)."""
+    from distributedfft_tpu.persist.state import _fit_padded
+
+    class _Plan:                              # p=4: ceil(18/4)*4 = 20
+        output_shape = (18, 18, 10)
+        output_padded_shape = (18, 20, 10)
+
+    class _Plan8:                             # p=8: ceil(18/8)*8 = 24
+        output_shape = (18, 18, 10)
+        output_padded_shape = (18, 24, 10)
+
+    host8 = np.zeros((18, 24, 10), np.complex128)
+    host8[:, :18, :] = np.random.default_rng(0).standard_normal(
+        (18, 18, 10))
+    out = _fit_padded(host8, _Plan())
+    assert out.shape == (18, 20, 10)
+    np.testing.assert_array_equal(out[:, :18], host8[:, :18])
+    assert not out[:, 18:].any()              # pad lanes: exact zeros
+    assert _fit_padded(host8, _Plan8()) is host8  # same shape: untouched
+    # growing back (4 -> 8) zero-extends the pad, logical intact
+    grown = _fit_padded(out, _Plan8())
+    np.testing.assert_array_equal(grown[:, :18], host8[:, :18])
+    assert grown.shape == (18, 24, 10) and not grown[:, 18:].any()
+
+
 # ---------------------------------------------------------------------------
 # policy
 # ---------------------------------------------------------------------------
@@ -347,6 +416,43 @@ def test_restore_refuses_mismatched_plan(tmp_path, devices):
     with pytest.raises(CheckpointMismatch) as ei:
         store.load(expect_fingerprint=fp)
     assert "wire" in ei.value.diffs
+
+
+@pytest.mark.slow  # two slab plan builds on the 8-dev mesh; the CI
+# chaos ``mesh`` scenario drives the same contract end-to-end per-PR
+def test_degraded_restore_across_mesh_shrink(tmp_path, devices):
+    """ISSUE 20 acceptance, in-process: a NS3D checkpoint captured on an
+    8-rank slab mesh restores into a 4-rank plan under
+    ``allow_mesh_change`` — refused by default, logical spectral region
+    bit-equal after the crop/re-pad (n=18 pads y to 24 on p=8 but 20 on
+    p=4), new pad lanes exact zeros, and the shrunken solver steps on."""
+    from distributedfft_tpu.models.slab import SlabFFTPlan
+    from distributedfft_tpu.solvers import NavierStokes3D, taylor_green_3d
+    cfg = pm.Config(double_prec=True)
+    g = pm.GlobalSize(18, 18, 18)
+    ns8 = NavierStokes3D(SlabFFTPlan(g, pm.SlabPartition(8), cfg), 1e-2)
+    step8 = jax.jit(ns8.step_fn(1e-3))
+    u = advance_steps(step8, ns8.to_spectral(taylor_green_3d(18)), 3)
+    store = CheckpointStore(str(tmp_path))
+    store.save(persist.capture(ns8, u, 3, 1e-3))
+    ns4 = NavierStokes3D(SlabFFTPlan(g, pm.SlabPartition(4), cfg), 1e-2)
+    fp4 = persist.plan_fingerprint(ns4.plan)
+    with pytest.raises(CheckpointMismatch) as ei:
+        store.load(expect_fingerprint=fp4)
+    assert set(ei.value.diffs) == {"ranks"}
+    sim = store.load(expect_fingerprint=fp4, allow_mesh_change=True)
+    back = persist.restore(sim, ns4)
+    ref = u if isinstance(u, tuple) else (u,)
+    got = back if isinstance(back, tuple) else (back,)
+    for r, g_ in zip(ref, got):
+        ra, ga = np.asarray(r), np.asarray(g_)
+        assert ra.shape == (18, 24, 10) and ga.shape == (18, 20, 10)
+        np.testing.assert_array_equal(ga[:, :18], ra[:, :18])
+        assert not ga[:, 18:].any()
+    # the restored state is live: the shrunken solver advances it
+    out = advance_steps(jax.jit(ns4.step_fn(1e-3)), back, 2)
+    for leaf in (out if isinstance(out, tuple) else (out,)):
+        assert np.isfinite(np.asarray(leaf)).all()
 
 
 # ---------------------------------------------------------------------------

@@ -164,6 +164,50 @@ def test_worker_fault_hooks_gate_on_victim_and_generation(monkeypatch):
     inject._WORKER_REQS[0] = 0
 
 
+def test_worker_devloss_spec_grammar_and_gating(monkeypatch):
+    """ISSUE 20: ``worker:devloss[:D]`` parses under the same strict
+    grammar (D = devices the victim's HOST loses, ``@seed=I`` the victim
+    index), the kill hook gates exactly like crash (victim index,
+    generation 0, ``$DFFT_DEVLOSS_AFTER``-th request), and the
+    parent-side ``devloss_cut`` answers D only for the victim's
+    RESPAWNED generations while the spec stays active — clearing the
+    spec models host repair (a full-size replacement)."""
+    s = parse_fault_spec("worker:devloss:4@seed=0")
+    assert (s.kind, s.mode, s.param, s.seed) == ("worker", "devloss",
+                                                 4.0, 0)
+    assert parse_fault_spec(str(s)) == s       # round-trips
+    assert parse_fault_spec("worker:devloss").param is None  # D defaults 1
+    from distributedfft_tpu.resilience.inject import parse_fault_specs
+    specs = parse_fault_specs("wire:nan,worker:devloss:2@seed=1")
+    assert [sp.mode for sp in specs] == ["nan", "devloss"]
+    with pytest.raises(ValueError):
+        parse_fault_spec("worker:devloss:2:3")
+
+    # unset spec: both hooks are exact no-ops
+    assert inject.maybe_devloss_worker(0, 0) is None
+    assert inject.devloss_cut(0, 1) == 0
+
+    monkeypatch.setenv(inject.ENV_VAR, "worker:devloss:4@seed=1")
+    monkeypatch.setenv("DFFT_DEVLOSS_AFTER", "99")  # never reaches exit
+    inject._WORKER_REQS[0] = 0
+    inject.maybe_devloss_worker(0, 0)   # wrong index: no count
+    inject.maybe_devloss_worker(1, 1)   # respawned generation: no count
+    assert inject._WORKER_REQS[0] == 0
+    inject.maybe_devloss_worker(1, 0)   # the victim, generation 0
+    assert inject._WORKER_REQS[0] == 1
+    inject._WORKER_REQS[0] = 0
+    # the parent-side cut: only the victim's replacements run short
+    assert inject.devloss_cut(1, 1) == 4
+    assert inject.devloss_cut(1, 2) == 4   # every generation while active
+    assert inject.devloss_cut(1, 0) == 0   # the first incarnation is full
+    assert inject.devloss_cut(0, 1) == 0   # non-victims are full
+    monkeypatch.setenv(inject.ENV_VAR, "worker:devloss@seed=1")
+    assert inject.devloss_cut(1, 1) == 1   # D defaults to one device
+    # spec cleared = host repaired: the NEXT respawn is full-size again
+    monkeypatch.delenv(inject.ENV_VAR)
+    assert inject.devloss_cut(1, 1) == 0
+
+
 def test_server_slow_injector(monkeypatch):
     monkeypatch.setenv(inject.ENV_VAR, "server:slow:60")
     t0 = time.perf_counter()

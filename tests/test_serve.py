@@ -219,7 +219,8 @@ def test_server_forward_inverse_roundtrip(devices):
 def test_server_rejects_malformed():
     with Server() as s:
         with pytest.raises(ValueError):
-            s.submit(np.zeros((4, 4, 4), np.float32))  # not 2D
+            # 2D images and 3D volumes are valid; 4D is not a request
+            s.submit(np.zeros((4, 4, 4, 4), np.float32))
         with pytest.raises(ValueError):
             s.submit(np.zeros((4, 4), np.complex64))   # r2c fwd wants real
         with pytest.raises(ValueError):
@@ -227,6 +228,62 @@ def test_server_rejects_malformed():
         with pytest.raises(ValueError):
             s.submit(np.zeros((4, 5), np.complex64), "r2c", "inverse",
                      ny=12)  # ny inconsistent with spectral width
+        with pytest.raises(ValueError):
+            # decomp is a volume-only axis (ISSUE 20)
+            s.submit(np.zeros((4, 4), np.float32), decomp="slab")
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((4, 4, 4), np.float32), decomp="tile")
+
+
+def test_served_volume_bit_identical_to_direct_plans(devices):
+    """ISSUE 20: a served 3D volume executes the SAME single-shot
+    slab/pencil program a direct caller would build — forward and
+    inverse outputs bit-identical to driving the plan family by hand,
+    r2c through the slab default and c2c through a per-request pencil
+    override, over the 8-device CPU mesh; volumes never coalesce."""
+    from distributedfft_tpu import params as pm
+    from distributedfft_tpu.models.pencil import PencilFFTPlan
+    from distributedfft_tpu.models.slab import SlabFFTPlan
+    from distributedfft_tpu.parallel.mesh import best_pencil_grid
+    rng = np.random.default_rng(5)
+    v = rng.random((16, 16, 16), dtype=np.float64).astype(np.float32)
+    z = (rng.random((16, 16, 16)) + 1j * rng.random((16, 16, 16))) \
+        .astype(np.complex64)
+    with Server(pm.SlabPartition(8)) as s:
+        got = np.asarray(s.request(v, "r2c"))
+        plan = SlabFFTPlan(pm.GlobalSize(16, 16, 16),
+                           pm.SlabPartition(8), pm.Config(),
+                           transform="r2c")
+        ref = np.asarray(plan.crop_spectral(plan.exec_r2c(v)))
+        np.testing.assert_array_equal(got, ref)
+        back = np.asarray(s.request(got, "r2c", "inverse", ny=16))
+        np.testing.assert_array_equal(
+            back, np.asarray(plan.crop_real(plan.exec_c2r(ref))))
+        # c2c through the pencil decomposition (per-request override)
+        gotz = np.asarray(s.request(z, "c2c", decomp="pencil"))
+        p1, p2 = best_pencil_grid(8)
+        pplan = PencilFFTPlan(pm.GlobalSize(16, 16, 16),
+                              pm.PencilPartition(p1, p2), pm.Config(),
+                              transform="c2c")
+        refz = np.asarray(pplan.crop_spectral(pplan.exec_c2c(z)))
+        np.testing.assert_array_equal(gotz, refz)
+        backz = np.asarray(s.request(gotz, "c2c", "inverse",
+                                     decomp="pencil"))
+        np.testing.assert_array_equal(
+            backz, np.asarray(pplan.crop_real(pplan.exec_c2c_inv(refz))))
+        h = s.health()
+        assert h["counters"]["coalesced"] == 0  # volumes never coalesce
+        # both families live in the cache under their REQUEST keys
+        assert any(k.startswith("fft3d/16x16x16/f32/r2c/slab")
+                   for k in h["plan_cache"]["keys"])
+        assert any("/c2c/pencil" in k for k in h["plan_cache"]["keys"])
+
+
+def test_describe_request_volume_lines():
+    from distributedfft_tpu.serve import describe_request
+    lines = "\n".join(describe_request(64, 64, 64, decomp="slab"))
+    assert "fft3d/64x64x64/f32/r2c/slab" in lines
+    assert "single-shot" in lines or "single slot" in lines
 
 
 def test_coalesced_bit_identical_to_single_shot(devices):

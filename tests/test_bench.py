@@ -26,21 +26,25 @@ def _run_bench(env_extra, timeout=420):
     return json.loads(lines[-1])
 
 
-def test_full_orchestration_off_tunnel():
+def test_full_orchestration_off_tunnel(tmp_path):
     """One full parent run: probe -> mesh metrics -> tpu child, all forced
     CPU. Must emit exactly one COMPACT JSON line with the driver contract
     keys (a truncated 2000-char tail capture must still parse) and a real
     measurement (no fallback: the 'tpu' child succeeds on CPU); the verbose
-    record lands in BENCH_DETAILS.json."""
+    record lands at DFFT_BENCH_DETAILS_PATH — redirected to tmp so this
+    starved CPU run can NEVER overwrite the committed BENCH_DETAILS.json,
+    which is the CI roofline gate's regression reference."""
     # fleet:1 starves the fleet child's budget so it SKIPS: spawning
     # 1+2+4 jax worker subprocesses (~25 s alone) would dominate this
     # test for a block it asserts nothing about — the CI roofline job
     # (fleet:120) and the committed BENCH_DETAILS.json cover it.
+    details = tmp_path / "BENCH_DETAILS.json"
     d = _run_bench({"DFFT_BENCH_FORCE_CPU": "1",
                     "DFFT_BENCH_SIZES": "32",
                     "DFFT_BENCH_BATCHED": "2,16,1",
                     "DFFT_BENCH_MESH_N": "32",
-                    "DFFT_BENCH_CHILD_TIMEOUT_S": "fleet:1"})
+                    "DFFT_BENCH_CHILD_TIMEOUT_S": "fleet:1",
+                    "DFFT_BENCH_DETAILS_PATH": str(details)})
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in d, d
     assert d["unit"] == "ms"
@@ -48,7 +52,7 @@ def test_full_orchestration_off_tunnel():
     # fit a 2000-char tail capture with room to spare.
     assert len(json.dumps(d)) < 2000, d
     assert d.get("details") == "BENCH_DETAILS.json", d
-    with open(os.path.join(REPO, "BENCH_DETAILS.json")) as f:
+    with open(details) as f:
         full = json.load(f)
     # The probe and tpu child both run on CPU, so sizes must carry a real
     # (non-degenerate) measurement for 32 and no process_broken fallback.
